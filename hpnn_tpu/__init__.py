@@ -1,0 +1,35 @@
+"""hpnn_tpu — a TPU-native high-performance neural-network framework.
+
+A ground-up reimplementation of the capabilities of libhpnn v0.2
+(the reference C library surveyed in SURVEY.md): training and running
+small fully-connected feed-forward networks ("kernels") embedded in
+scientific workflows, with
+
+* the same observable surface — ``.conf`` / kernel / sample text file
+  formats, ``train_nn`` / ``run_nn`` CLIs, stdout token protocol, and
+  seed-for-seed reproducibility (glibc ``random()`` emulation) — and
+* a TPU-first core: forward / delta / update passes are JAX/XLA-jitted
+  MXU matmuls over a ``Kernel`` pytree resident in HBM, the per-sample
+  do-while convergence loop is a ``lax.while_loop`` compiled once and
+  iterated on-device, layer-dim tensor parallelism replaces the
+  reference's per-layer MPI row-split + ``MPI_Allgather``
+  (ref: /root/reference/src/ann.c:912-936), and a data-parallel batch
+  mode with ``lax.psum`` gradient reduction over ICI replaces the
+  MPI_Allreduce scaling path.
+"""
+
+from hpnn_tpu import runtime
+from hpnn_tpu.config import NNConf, NNType, NNTrain, load_conf, dump_conf
+from hpnn_tpu.models.kernel import Kernel
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "runtime",
+    "NNConf",
+    "NNType",
+    "NNTrain",
+    "load_conf",
+    "dump_conf",
+    "Kernel",
+]

@@ -1,0 +1,109 @@
+"""Shared CLI argument handling for train_nn / run_nn.
+
+Reproduces the reference CLIs' flag grammar
+(ref: /root/reference/tests/train_nn.c:59-255, tests/run_nn.c):
+``-h`` help, ``-v`` (repeatable/combinable) verbosity, ``-x`` dry
+toggle, ``-O n``/``-On`` OMP threads, ``-B n``/``-Bn`` BLAS threads,
+``-S n``/``-Sn`` CUDA-stream count (advisory on TPU), plus one
+positional ``.conf`` file (default ``./nn.conf``).
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+
+from hpnn_tpu import runtime
+
+
+def install_sigpipe_handler() -> None:
+    """Die quietly when stdout is a closed pipe (e.g. ``train_nn -h | head``)."""
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (ValueError, AttributeError):
+        pass
+
+
+def dump_help(prog: str) -> None:
+    w = sys.stdout.write
+    w("***********************************\n")
+    w(f"usage:  {prog} [-options] [input]\n")
+    w("***********************************\n")
+    w("options:\n")
+    w("-h \tdisplay this help;\n")
+    w("-v \tincrease verbosity;\n")
+    w("-x \tdiscard results.\n")
+    w("-O \tnumber of openMP threads.\n")
+    w("-B \tnumber of BLAS threads (MKL).\n")
+    w("-S \tnumber of CUDA streams.\n")
+    w("***********************************\n")
+    w("input:     neural network .def file\n")
+    w("contains the network definition and\n")
+    w("topology. May contain weight values\n")
+    w("or context for a random generation.\n")
+    w("***********************************\n")
+
+
+def parse_args(argv: list[str], prog: str) -> str | None:
+    """Apply flags to the runtime; return the conf filename or None.
+
+    Returns None when the process should exit (help shown or error).
+    """
+    filename = None
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg.startswith("-") and len(arg) > 1:
+            j = 1
+            while j < len(arg):
+                c = arg[j]
+                if c == "h":
+                    dump_help(prog)
+                    return None
+                if c == "v":
+                    runtime.inc_verbose()
+                    j += 1
+                    continue
+                if c == "x":
+                    runtime.toggle_dry()
+                    j += 1
+                    continue
+                if c in "OBS":
+                    if j + 1 < len(arg):
+                        num = arg[j + 1 :]
+                    else:
+                        i += 1
+                        if i >= len(argv):
+                            sys.stderr.write(
+                                f"syntax error: bad -{c} parameter!\n"
+                            )
+                            dump_help(prog)
+                            return None
+                        num = argv[i]
+                    if not num.strip() or not num.strip()[0].isdigit():
+                        sys.stderr.write(f"syntax error: bad -{c} parameter!\n")
+                        dump_help(prog)
+                        return None
+                    n = int("".join(ch for ch in num.strip() if ch.isdigit()) or 0)
+                    if n == 0 and c != "S":
+                        sys.stderr.write(f"syntax error: bad -{c} parameter!\n")
+                        dump_help(prog)
+                        return None
+                    if c == "O":
+                        runtime.set_omp_threads(n)
+                    elif c == "B":
+                        runtime.set_omp_blas(n)
+                    else:
+                        runtime.set_cuda_streams(max(1, n))
+                    break  # no combination after -O/-B/-S
+                sys.stderr.write("syntax error: unrecognized option!\n")
+                dump_help(prog)
+                return None
+        else:
+            if filename is not None:
+                sys.stderr.write("syntax error: unrecognized option!\n")
+                dump_help(prog)
+                return None
+            filename = arg
+        i += 1
+    return filename or "./nn.conf"

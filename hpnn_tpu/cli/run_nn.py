@@ -1,0 +1,34 @@
+"""``run_nn`` — load conf, evaluate the tests directory.
+
+Mirrors the reference driver (ref: /root/reference/tests/run_nn.c).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from hpnn_tpu import config, runtime
+from hpnn_tpu.cli import common
+from hpnn_tpu.train import driver
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    common.install_sigpipe_handler()
+    runtime.init_all(1)
+    filename = common.parse_args(argv, "run_nn")
+    if filename is None:
+        runtime.deinit_all()
+        return 0
+    conf = config.load_conf(filename)
+    if conf is None:
+        sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    driver.run_kernel(conf)
+    runtime.deinit_all()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+"""``train_nn`` — load conf, dump kernel.tmp, train, dump kernel.opt.
+
+Command-line and control flow mirror the reference driver
+(ref: /root/reference/tests/train_nn.c:59-255).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from hpnn_tpu import config, runtime
+from hpnn_tpu.cli import common
+from hpnn_tpu.train import driver
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    common.install_sigpipe_handler()
+    runtime.init_all(1)
+    filename = common.parse_args(argv, "train_nn")
+    if filename is None:
+        runtime.deinit_all()
+        return 0
+    conf = config.load_conf(filename)
+    if conf is None:
+        sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    try:
+        with open("kernel.tmp", "w") as fp:
+            config.dump_kernel(conf, fp)
+    except OSError:
+        sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
+        runtime.deinit_all()
+        return -1
+    if not driver.train_kernel(conf):
+        sys.stderr.write("FAILED to train kernel!\n")
+        runtime.deinit_all()
+        return -1
+    try:
+        with open("kernel.opt", "w") as fp:
+            config.dump_kernel(conf, fp)
+    except OSError:
+        sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
+        runtime.deinit_all()
+        return -1
+    runtime.deinit_all()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Sample-file reader (and directory scanning helpers).
+
+Sample text format (ref parser: /root/reference/src/libhpnn.c:1070-1145):
+
+    [input] N        <- optional trailing comment tolerated
+    v1 v2 ... vN     <- the line immediately after
+    [output] M
+    t1 t2 ... tM
+
+Directory scanning skips dotfiles and preserves readdir order — the
+reference builds its file list straight from ``readdir`` (ref:
+src/libhpnn.c:1190-1214), and the glibc-seeded shuffle indexes into
+that order, so readdir order is part of the reproducibility contract.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
+    """Read one sample file → (input vector, target vector), or None."""
+    try:
+        with open(path, "r") as fp:
+            lines = fp.readlines()
+    except OSError:
+        return None
+    vin = vout = None
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if "[input" in line:
+            n = _count_after(line, "[input")
+            if n is None or n == 0 or i + 1 >= len(lines):
+                return None
+            vin = np.fromstring(lines[i + 1], dtype=np.float64, sep=" ")
+            if vin.size < n:
+                return None
+            vin = vin[:n]
+            i += 1
+        elif "[output" in line:
+            n = _count_after(line, "[output")
+            if n is None or n == 0 or i + 1 >= len(lines):
+                return None
+            vout = np.fromstring(lines[i + 1], dtype=np.float64, sep=" ")
+            if vout.size < n:
+                return None
+            vout = vout[:n]
+            i += 1
+        i += 1
+    if vin is None or vout is None:
+        return None
+    return vin, vout
+
+
+def _count_after(line: str, tag: str) -> int | None:
+    rest = line[line.find(tag) + len(tag) + 1 :].lstrip(" \t")
+    if not rest or not rest[0].isdigit():
+        return None
+    digits = ""
+    for ch in rest:
+        if ch.isdigit():
+            digits += ch
+        else:
+            break
+    return int(digits)
+
+
+def list_sample_files(directory: str) -> list[str]:
+    """File names in readdir order, dotfiles skipped (no sorting!)."""
+    names = []
+    with os.scandir(directory) as it:
+        for entry in it:
+            if entry.name.startswith("."):
+                continue
+            names.append(entry.name)
+    return names

@@ -1,0 +1,115 @@
+"""The ``Kernel`` pytree: the framework's network/state object.
+
+Replaces the reference's ``kernel_ann`` struct
+(ref: /root/reference/include/libhpnn/ann.h:35-55) — flat row-major
+weight matrices per layer plus optional momentum arrays — with an
+immutable JAX pytree of ``(N, M)`` arrays.  Activations are not stored
+on the object (the reference keeps per-layer ``vec`` scratch arrays;
+here they are values flowing through jitted functions).
+
+Multi-GPU replica bookkeeping (``kerns[]``, ref: ann.h:51-54) has no
+equivalent: replication/sharding is expressed with
+``jax.sharding.NamedSharding`` on these same arrays (see
+``hpnn_tpu.parallel``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from hpnn_tpu.fileio import kernel_format
+from hpnn_tpu.utils.glibc_random import RAND_MAX, GlibcRandom
+
+
+class Kernel(NamedTuple):
+    """weights[l] has shape (n_neurons_l, n_inputs_l), row-major.
+
+    Layers 0..n-2 are the hidden layers, layer n-1 is the output layer
+    (the reference's ``hiddens[]`` + ``output``).
+    """
+
+    weights: tuple
+
+    @property
+    def n_inputs(self) -> int:
+        return self.weights[0].shape[1]
+
+    @property
+    def n_outputs(self) -> int:
+        return self.weights[-1].shape[0]
+
+    @property
+    def n_hiddens(self) -> int:
+        return len(self.weights) - 1
+
+    @property
+    def hidden_sizes(self) -> tuple[int, ...]:
+        return tuple(w.shape[0] for w in self.weights[:-1])
+
+    def astype(self, dtype) -> "Kernel":
+        return Kernel(tuple(w.astype(dtype) for w in self.weights))
+
+
+def generate(
+    seed: int,
+    n_inputs: int,
+    hiddens: Sequence[int],
+    n_outputs: int,
+    dtype=np.float64,
+) -> tuple[Kernel, int]:
+    """Seeded random kernel, bit-identical to ``ann_generate``.
+
+    Weights are drawn layer by layer (hiddens first, then output) in
+    row-major order from the glibc stream:
+    ``w = 2*(random()/RAND_MAX - 0.5)/sqrt(M)``
+    (ref: /root/reference/src/ann.c:653-677,700-706).
+
+    Returns (kernel, effective_seed) — seed 0 is replaced by current
+    time, as the reference does (ref: src/ann.c:653).
+    """
+    import time
+
+    if seed == 0:
+        seed = int(time.time())
+    rng = GlibcRandom(seed)
+    sizes = list(hiddens) + [n_outputs]
+    inputs = [n_inputs] + list(hiddens)
+    weights = []
+    for n, m in zip(sizes, inputs):
+        scale = 1.0 / np.sqrt(float(m))
+        vals = np.empty(n * m, dtype=np.float64)
+        for j in range(n * m):
+            vals[j] = 2.0 * (rng.random() / RAND_MAX - 0.5) * scale
+        weights.append(vals.reshape(n, m).astype(dtype))
+    return Kernel(tuple(weights)), seed
+
+
+def zeros_like_momentum(kernel: Kernel) -> Kernel:
+    """Momentum ``dw`` arrays (ref: ``ann_momentum_init``, src/ann.c:1876)."""
+    return Kernel(tuple(np.zeros_like(np.asarray(w)) for w in kernel.weights))
+
+
+def validate(kernel: Kernel) -> bool:
+    """Shape chain check (ref: ``ann_validate_kernel``, src/ann.c:862-879)."""
+    if len(kernel.weights) < 2:
+        return False
+    for a, b in zip(kernel.weights[:-1], kernel.weights[1:]):
+        if b.shape[1] != a.shape[0]:
+            return False
+    return all(w.shape[0] >= 1 and w.shape[1] >= 1 for w in kernel.weights)
+
+
+def load(path: str) -> tuple[str, Kernel]:
+    name, ws = kernel_format.load_kernel(path)
+    k = Kernel(tuple(ws))
+    if not validate(k):
+        raise kernel_format.KernelFormatError(f"inconsistent kernel file {path}")
+    return name, k
+
+
+def dump(name: str, kernel: Kernel, fp) -> None:
+    kernel_format.dump_kernel(
+        name, [np.asarray(w, dtype=np.float64) for w in kernel.weights], fp
+    )

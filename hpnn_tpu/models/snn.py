@@ -1,0 +1,76 @@
+"""SNN (softmax-output MLP) numerics.
+
+The SNN kernel shares the ANN's hidden layers and differs only at the
+output and in the loss (ref: /root/reference/src/snn.c, SURVEY.md §2.4):
+
+* forward: hidden layers as ANN; output logits ``z = W·v`` are turned
+  into ``o_i = exp(z_i - 1) / dv`` with ``dv = TINY + Σ_j exp(z_j - 1)``
+  — note the reference's quirks, reproduced exactly: the constant ``-1``
+  shift (NOT a max-subtraction) and the TINY=1e-14 seed of the
+  denominator (ref: src/snn.c:282-335; common.h:79).
+* error: cross-entropy ``Ep = -(1/N) Σ t_i log(o_i + TINY)``
+  (ref: src/snn.c:444-477).
+* deltas: output ``δ = (t - o)`` (softmax+CE shortcut, no dact,
+  ref: src/snn.c:510-512); hidden layers identical to ANN.
+* updates: same shapes as ANN but η = LEARN_RATE = 0.01 for BOTH the
+  plain and the momentum path (ref: src/snn.c:799 — unlike the ANN,
+  the SNN really does use the 0.01 define everywhere).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hpnn_tpu.models import ann
+
+TINY = 1e-14
+SNN_LEARN_RATE = 0.01
+
+
+def forward(weights, x):
+    acts = [x]
+    v = x
+    for w in weights[:-1]:
+        v = ann.act(w @ v)
+        acts.append(v)
+    z = weights[-1] @ v
+    e = jnp.exp(z - 1.0)
+    dv = TINY + jnp.sum(e)
+    acts.append(e / dv)
+    return tuple(acts)
+
+
+def run(weights, x):
+    return forward(weights, x)[-1]
+
+
+def train_error(out, target):
+    n = out.shape[0]
+    return -jnp.sum(target * jnp.log(out + TINY)) / n
+
+
+def deltas(weights, acts, target):
+    ds = [target - acts[-1]]
+    for l in range(len(weights) - 1, 0, -1):
+        ds.insert(0, (weights[l].T @ ds[0]) * ann.dact(acts[l]))
+    return tuple(ds)
+
+
+def train_iteration(weights, acts, x, target):
+    """One SNN BP iteration (``snn_kernel_train``, src/snn.c:796-1075)."""
+    ep = train_error(acts[-1], target)
+    ds = deltas(weights, acts, target)
+    weights = ann.bp_update(weights, acts, ds, SNN_LEARN_RATE)
+    acts = forward(weights, x)
+    epr = train_error(acts[-1], target)
+    return weights, acts, ep - epr
+
+
+def train_iteration_momentum(weights, dw, acts, x, target, alpha):
+    """One SNN BPM iteration (``snn_kernel_train_momentum``, src/snn.c:1077)."""
+    ep = train_error(acts[-1], target)
+    ds = deltas(weights, acts, target)
+    weights, dw = ann.bpm_update(weights, dw, acts, ds, SNN_LEARN_RATE, alpha)
+    acts = forward(weights, x)
+    epr = train_error(acts[-1], target)
+    return weights, dw, acts, ep - epr

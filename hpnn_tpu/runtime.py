@@ -1,0 +1,250 @@
+"""Runtime / capability / hardware-init layer (TPU-native).
+
+Reimplements the reference's L1 runtime layer
+(ref: /root/reference/src/libhpnn.c:60-539): a capability registry, a
+global runtime singleton, and per-backend init/deinit + setters.
+
+TPU mapping:
+
+* ``NN_CAP_TPU`` replaces CUDA/CUBLAS as the accelerator capability;
+  detection probes ``jax.devices()`` instead of ``cudaGetDeviceCount``
+  (ref: src/libhpnn.c:201-305).
+* MPI init/task-count (ref: src/libhpnn.c:182-200) becomes the JAX
+  distributed runtime — ``jax.process_count()`` / ``process_index``;
+  the coordinator replaces ``mpirun``.
+* OMP/BLAS thread counts (ref: src/libhpnn.c:173-181,306-325) are kept
+  as accepted-but-advisory knobs: XLA:CPU does its own intra-op
+  threading, so the setters record the value and export the standard
+  env hints when possible.
+* The CUDA stream pool (ref: src/libhpnn.c:471-513) is absorbed by the
+  XLA scheduler; ``set_cuda_streams`` survives as an advisory no-op so
+  the ``-S`` CLI flag keeps parsing.
+* The reference's multi-GPU memory-model probe (P2P/CMM/EXP, ref:
+  src/libhpnn.c:245-302) maps to a ``jax.sharding.Mesh``: replication
+  and collectives are sharding specs, not hand-written copies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import sys
+from typing import Any
+
+from hpnn_tpu.utils import logging as log
+
+
+class NNCap(enum.IntFlag):
+    """Capability bits (ref: /root/reference/include/libhpnn.h:26-35)."""
+
+    NONE = 0
+    OMP = 1 << 0      # intra-host threading (XLA:CPU intra-op)
+    MPI = 1 << 1      # multi-process (JAX distributed runtime)
+    CUDA = 1 << 2     # kept for surface parity; never set on TPU builds
+    CUBLAS = 1 << 3   # kept for surface parity; never set on TPU builds
+    # (1<<4) reserved for OCL in the reference
+    PBLAS = 1 << 5    # whole-layer matmul path (MXU)
+    SBLAS = 1 << 6    # per-row path; absorbed, never set
+    TPU = 1 << 7      # NEW: XLA accelerator backend present
+
+
+@dataclasses.dataclass
+class NNRuntime:
+    """Global runtime parameters (ref: include/libhpnn.h:39-47)."""
+
+    capability: NNCap = NNCap.NONE
+    nn_verbose: int = 0
+    nn_dry: bool = False
+    nn_num_threads: int = 1
+    nn_num_blas: int = 1
+    nn_num_tasks: int = 1
+    nn_num_streams: int = 1   # advisory (absorbed by XLA scheduling)
+    n_devices: int = 0        # accelerator device count
+    platform: str = "cpu"
+    devices: tuple[Any, ...] = ()
+
+
+_runtime = NNRuntime()
+_initialized = False
+
+
+def runtime() -> NNRuntime:
+    return _runtime
+
+
+# ---------------------------------------------------------------- verbosity
+def set_verbose(v: int) -> None:
+    _runtime.nn_verbose = v
+    log.set_verbose(v)
+
+
+def inc_verbose() -> None:
+    log.inc_verbose()
+    _runtime.nn_verbose = log.get_verbose()
+
+
+def dec_verbose() -> None:
+    log.dec_verbose()
+    _runtime.nn_verbose = log.get_verbose()
+
+
+def return_verbose() -> int:
+    return log.get_verbose()
+
+
+def toggle_dry() -> None:
+    # The reference's toggle is a no-op bug (`x^=x`, ref:
+    # src/libhpnn.c:88-90) and nn_dry is never read; we implement the
+    # intended toggle but likewise never act on it.
+    _runtime.nn_dry = not _runtime.nn_dry
+
+
+# -------------------------------------------------------------- capabilities
+def get_capabilities() -> NNCap:
+    return _runtime.capability
+
+
+def unset_capability(cap: NNCap) -> None:
+    _runtime.capability &= ~cap
+
+
+# ------------------------------------------------------------------- inits
+def init_runtime() -> None:
+    global _runtime
+    _runtime = NNRuntime()
+    log.set_verbose(0)
+
+
+def init_dist() -> bool:
+    """Multi-process init (replaces ``_NN(init,MPI)`` / ``MPI_Init``).
+
+    If the standard JAX distributed env (``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``) is present, join the
+    cluster; otherwise stay single-process.
+    """
+    import jax
+
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    if coord and nproc and int(nproc) > 1:
+        try:
+            jax.distributed.initialize()
+        except Exception as exc:  # already initialized or misconfigured
+            log.nn_warn(sys.stderr, "distributed init failed: %s\n", exc)
+    n = jax.process_count()
+    _runtime.nn_num_tasks = n
+    if n > 1:
+        _runtime.capability |= NNCap.MPI
+    elif coord:
+        log.nn_warn(sys.stdout, "#tasks=1 detected: no distributed!\n")
+    return True
+
+
+def init_threads() -> bool:
+    """Intra-host threading init (replaces ``_NN(init,OMP)``)."""
+    n = int(os.environ.get("OMP_NUM_THREADS", 0) or 0)
+    if n < 1:
+        n = os.cpu_count() or 1
+    _runtime.nn_num_threads = n
+    _runtime.nn_num_blas = n
+    _runtime.capability |= NNCap.OMP | NNCap.PBLAS
+    return True
+
+
+def init_tpu() -> bool:
+    """Accelerator probe (replaces ``_NN(init,CUDA)``'s device probe)."""
+    import jax
+
+    try:
+        devs = jax.devices()
+    except Exception as exc:
+        log.nn_warn(sys.stderr, "no accelerator platform: %s\n", exc)
+        return False
+    _runtime.devices = tuple(devs)
+    _runtime.n_devices = len(devs)
+    _runtime.platform = devs[0].platform if devs else "cpu"
+    if _runtime.platform != "cpu":
+        _runtime.capability |= NNCap.TPU
+    return True
+
+
+def init_all(init_verbose: int = 0) -> int:
+    """``_NN(init,all)`` equivalent (ref: src/libhpnn.c:326-347)."""
+    global _initialized
+    init_runtime()
+    if init_verbose:
+        set_verbose(init_verbose)
+    init_dist()
+    init_threads()
+    init_tpu()
+    _initialized = True
+    log.nn_out(
+        sys.stdout,
+        "runtime: platform=%s devices=%i tasks=%i threads=%i\n",
+        _runtime.platform,
+        _runtime.n_devices,
+        _runtime.nn_num_tasks,
+        _runtime.nn_num_threads,
+    )
+    return 0
+
+
+def deinit_all() -> int:
+    global _initialized
+    _initialized = False
+    _runtime.capability = NNCap.NONE
+    return 0
+
+
+# ----------------------------------------------------------------- setters
+def set_omp_threads(n: int) -> bool:
+    _runtime.nn_num_threads = max(1, int(n))
+    os.environ["OMP_NUM_THREADS"] = str(_runtime.nn_num_threads)
+    return True
+
+
+def get_omp_threads() -> int:
+    return _runtime.nn_num_threads
+
+
+def set_omp_blas(n: int) -> bool:
+    _runtime.nn_num_blas = max(1, int(n))
+    return True
+
+
+def get_omp_blas() -> int:
+    return _runtime.nn_num_blas
+
+
+def set_cuda_streams(n: int) -> bool:
+    # Advisory: stream-level slicing is absorbed by the XLA scheduler
+    # (ref stream pool: src/libhpnn.c:471-513).
+    _runtime.nn_num_streams = max(1, int(n))
+    return True
+
+
+def get_cuda_streams() -> int:
+    return _runtime.nn_num_streams
+
+
+def set_mpi_tasks(n: int) -> bool:
+    # Task count is fixed by the launch environment, as in MPI.
+    return False
+
+
+def get_mpi_tasks() -> int:
+    return _runtime.nn_num_tasks
+
+
+def process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def n_devices() -> int:
+    return _runtime.n_devices

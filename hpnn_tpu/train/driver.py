@@ -1,0 +1,217 @@
+"""Training and evaluation drivers (the reference's L3 workload layer).
+
+``train_kernel`` reimplements ``_NN(train,kernel)``
+(ref: /root/reference/src/libhpnn.c:1149-1305): scan the samples dir,
+seed the glibc stream, draw files in random order without replacement,
+and train each sample to convergence; ``run_kernel`` reimplements
+``_NN(run,kernel)`` (src/libhpnn.c:1306-1536): same scan/shuffle over
+the tests dir, forward pass, argmax vs target.
+
+The stdout tokens are a de-facto metrics API consumed by the tutorial
+monitor scripts (they grep ``OK`` and ``PASS`` counts into accuracy
+time series, ref: tutorials/mnist/tutorial.bash:179-196) and are
+reproduced byte-for-byte:
+
+    NN: TRAINING FILE: %16.16s\\t init=... OK|NO N_ITER=... final=... SUCCESS!|FAIL!
+    NN: TESTING FILE: %16.16s\\t [PASS] | [FAIL idx=N]
+
+Quirks preserved: SNN BP ends with ``final=...\\n`` and never prints
+SUCCESS!/FAIL! (ref: src/snn.c:1495-1497); the SNN eval path prints a
+``BEST CLASS`` token and, at -vvv, a class-probability table
+(ref: src/libhpnn.c:1489-1508); LNN configs are routed down the SNN
+path by the drivers' switch (ref: src/libhpnn.c:1249,1458).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from hpnn_tpu.config import NNConf, NNTrain, NNType
+from hpnn_tpu.fileio import samples as sample_io
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.train import loop
+from hpnn_tpu.utils import logging as log
+from hpnn_tpu.utils.glibc_random import GlibcRandom
+
+
+def _compute_dtype():
+    import jax
+
+    # Parity mode: f64 on CPU (requires jax_enable_x64); TPU runs f32.
+    dt = os.environ.get("HPNN_DTYPE")
+    if dt:
+        return np.dtype(dt)
+    if jax.config.jax_enable_x64:
+        return np.dtype(np.float64)
+    return np.dtype(np.float32)
+
+
+def _shuffled_files(directory: str, seed: int):
+    """Yield file names in the reference's seeded random draw order."""
+    flist = sample_io.list_sample_files(directory)
+    n = len(flist)
+    rng = GlibcRandom(seed)
+    taken = [False] * n
+    for _ in range(n):
+        idx = rng.draw_index(n)
+        while taken[idx]:
+            idx = rng.draw_index(n)
+        taken[idx] = True
+        yield flist[idx]
+
+
+def train_kernel(conf: NNConf) -> bool:
+    """Train every sample in ``conf.samples`` once (one 'round')."""
+    import jax.numpy as jnp
+
+    if conf.kernel is None or conf.samples is None or conf.type == NNType.UKN:
+        return False
+    if conf.train not in (NNTrain.BP, NNTrain.BPM):
+        # CG/SPLX parse but are unimplemented (ref: src/libhpnn.c:1253-1257)
+        return True
+    if not os.path.isdir(conf.samples):
+        log.nn_error(sys.stderr, "can't open sample directory: %s\n", conf.samples)
+        return False
+
+    dtype = _compute_dtype()
+    momentum = conf.train == NNTrain.BPM
+    model = "snn" if conf.type in (NNType.SNN, NNType.LNN) else "ann"
+    if momentum:
+        min_iter, max_iter = loop.MIN_BPM_ITER, loop.MAX_BPM_ITER
+        delta = loop.DELTA_BPM
+    else:
+        min_iter, max_iter = loop.MIN_BP_ITER, loop.MAX_BP_ITER
+        delta = loop.DELTA_BP
+    alpha = 0.2  # ref: src/libhpnn.c:1248 — BPM always called with .2
+
+    weights = tuple(jnp.asarray(np.asarray(w), dtype=dtype) for w in conf.kernel.weights)
+    # momentum arrays live for the whole round (ann_momentum_init) and
+    # are zeroed per sample (ann_raz_momentum inside train_BPM).
+    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+
+    if conf.seed == 0:
+        conf.seed = int(time.time())
+    for fname in _shuffled_files(conf.samples, conf.seed):
+        log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
+        sample = sample_io.read_sample(os.path.join(conf.samples, fname))
+        if sample is None:
+            continue
+        tr_in, tr_out = sample
+        x = jnp.asarray(tr_in, dtype=dtype)
+        t = jnp.asarray(tr_out, dtype=dtype)
+        if momentum:
+            dw = tuple(jnp.zeros_like(w) for w in weights)  # raz_momentum
+        res = loop.train_sample(
+            weights,
+            dw,
+            x,
+            t,
+            alpha,
+            delta,
+            model=model,
+            momentum=momentum,
+            min_iter=min_iter,
+            max_iter=max_iter,
+        )
+        weights, dw = res.weights, res.dw
+        _print_train_tokens(res, model, momentum)
+    conf.kernel = kernel_mod.Kernel(tuple(np.asarray(w) for w in weights))
+    return True
+
+
+def _print_train_tokens(res, model: str, momentum: bool) -> None:
+    log.nn_cout(sys.stdout, " init=%15.10f", float(res.ep0))
+    log.nn_cout(sys.stdout, " OK" if bool(res.first_ok) else " NO")
+    log.nn_cout(sys.stdout, " N_ITER=%8i", int(res.n_iter))
+    if model == "snn" and not momentum:
+        # SNN BP quirk: no SUCCESS!/FAIL! (ref: src/snn.c:1495-1497)
+        log.nn_cout(sys.stdout, " final=%15.10f\n", float(res.dep))
+    else:
+        log.nn_cout(sys.stdout, " final=%15.10f", float(res.dep))
+        log.nn_cout(sys.stdout, " SUCCESS!\n" if bool(res.final_ok) else " FAIL!\n")
+    log.flush()
+
+
+def run_kernel(conf: NNConf) -> None:
+    """Evaluate every sample in ``conf.tests`` (argmax vs target)."""
+    import jax.numpy as jnp
+
+    if conf.kernel is None or conf.tests is None or conf.type == NNType.UKN:
+        return
+    if not os.path.isdir(conf.tests):
+        log.nn_error(sys.stderr, "can't open test directory: %s\n", conf.tests)
+        return
+    dtype = _compute_dtype()
+    model = "snn" if conf.type in (NNType.SNN, NNType.LNN) else "ann"
+    weights = tuple(jnp.asarray(np.asarray(w), dtype=dtype) for w in conf.kernel.weights)
+
+    if conf.seed == 0:
+        conf.seed = int(time.time())
+    for fname in _shuffled_files(conf.tests, conf.seed):
+        log.nn_out(sys.stdout, "TESTING FILE: %16.16s\t", fname)
+        sample = sample_io.read_sample(os.path.join(conf.tests, fname))
+        if sample is None:
+            continue
+        tr_in, tr_out = sample
+        out = np.asarray(
+            loop.run_sample(weights, jnp.asarray(tr_in, dtype=dtype), model=model)
+        )
+        if model == "ann":
+            # ref: src/libhpnn.c:1443-1457 — target threshold 0.5,
+            # LAST index above threshold wins
+            guess = _first_argmax(out)
+            # C quirk: is_ok starts at TRUE==1, so an all-negative
+            # target leaves class index 1 (ref: src/libhpnn.c:1443)
+            is_ok = _last_above(tr_out, 0.5, default=1)
+            if guess == is_ok:
+                log.nn_cout(sys.stdout, " [PASS]\n")
+            else:
+                log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
+        else:
+            # ref: src/libhpnn.c:1489-1514 — threshold 0.1, plus the
+            # BEST CLASS token and -vvv probability table
+            log.nn_dbg(sys.stdout, " CLASS | PROBABILITY (%%)\n")
+            log.nn_dbg(sys.stdout, "-------|----------------\n")
+            for idx in range(out.shape[0]):
+                log.nn_dbg(sys.stdout, " %5i | %15.10f\n", idx + 1, out[idx] * 100.0)
+            log.nn_dbg(sys.stdout, "-------|----------------\n")
+            guess = _first_argmax_pos(out)
+            is_ok = _last_above(tr_out, 0.1, default=0)
+            log.nn_cout(
+                sys.stdout, " BEST CLASS idx=%i P=%15.10f", guess + 1, out[guess] * 100.0
+            )
+            if guess == is_ok:
+                log.nn_cout(sys.stdout, " [PASS]\n")
+            else:
+                log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
+        log.flush()
+
+
+def _first_argmax(out: np.ndarray) -> int:
+    """First index of the maximum, starting from probe=-1 (ANN eval)."""
+    res, guess = -1.0, out.shape[0]
+    for idx in range(out.shape[0]):
+        if res < out[idx]:
+            guess, res = idx, out[idx]
+    return guess
+
+
+def _first_argmax_pos(out: np.ndarray) -> int:
+    """SNN eval starts from probe=0 and keeps index 0 on ties."""
+    res, guess = 0.0, 0
+    for idx in range(out.shape[0]):
+        if out[idx] > res:
+            res, guess = out[idx], idx
+    return guess
+
+
+def _last_above(target: np.ndarray, thr: float, default: int = 0) -> int:
+    ok = default
+    for idx in range(target.shape[0]):
+        if target[idx] > thr:
+            ok = idx
+    return ok

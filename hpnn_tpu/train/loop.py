@@ -1,0 +1,123 @@
+"""Per-sample convergence training loop — the innermost hot loop.
+
+The reference trains each sample with a data-dependent do-while (up to
+102399 iterations) around one backprop step + re-forward
+(``ann_train_BP``/``ann_train_BPM``, ref: /root/reference/src/ann.c:
+2281-2467; ``snn_train_BP/BPM``, src/snn.c:1414-1597):
+
+    iter = 0
+    do {
+        iter++
+        dEp = train_step()               # Ep - Epr of this step
+        is_ok = argmax(out) == argmax-of-last(target == 1.0)
+        if iter == 1: record first-try OK/NO
+        if iter > MAX_ITER: break        # before the MIN clamp!
+        is_ok &= (iter > MIN_ITER)
+    } while (dEp > delta || !is_ok)
+
+On TPU this whole loop is a single ``lax.while_loop`` jitted once per
+kernel shape and iterated entirely on-device — the host only supplies
+(x, target) and reads back five scalars, where the reference re-launched
+~(n_layers × streams × 3) CUDA kernels per iteration (SURVEY.md §3.1).
+
+Iteration bounds (ref: include/libhpnn.h:67-74): BP 31..102399,
+BPM 15..102399, both with delta = 1e-6.  Quirk preserved: the max-iter
+break happens *before* the min-iter clamp, and on that path the C code
+reports the raw argmax match — numerically identical to clamping since
+MAX > MIN.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from hpnn_tpu.models import ann, snn
+
+MIN_BP_ITER = 31
+MAX_BP_ITER = 102399
+DELTA_BP = 1e-6
+MIN_BPM_ITER = 15
+MAX_BPM_ITER = 102399
+DELTA_BPM = 1e-6
+
+
+class SampleResult(NamedTuple):
+    weights: tuple
+    dw: tuple
+    ep0: jax.Array       # error after initial forward ( init= token)
+    n_iter: jax.Array    # iterations executed ( N_ITER= token)
+    dep: jax.Array       # last Ep-Epr ( final= token)
+    first_ok: jax.Array  # argmax match after iteration 1 ( OK/ NO token)
+    final_ok: jax.Array  # reported SUCCESS!/FAIL!
+    out: jax.Array       # final output vector
+
+
+def _target_argmax(target):
+    """p_trg: LAST index with target exactly 1.0, else 0 (ref C loop)."""
+    n = target.shape[0]
+    return jnp.max(jnp.where(target == 1.0, jnp.arange(n), 0))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "momentum", "min_iter", "max_iter")
+)
+def train_sample(
+    weights,
+    dw,
+    x,
+    target,
+    alpha,
+    delta,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int = MIN_BP_ITER,
+    max_iter: int = MAX_BP_ITER,
+):
+    """Train one sample to convergence.  Jitted once per kernel shape."""
+    mod = snn if model == "snn" else ann
+    acts0 = mod.forward(weights, x)
+    ep0 = mod.train_error(acts0[-1], target)
+    p_trg = _target_argmax(target)
+
+    def body(state):
+        w, m, acts, it, _dep, _ok, first_ok = state
+        it = it + 1
+        if momentum:
+            w, m, acts, dep = mod.train_iteration_momentum(
+                w, m, acts, x, target, alpha
+            )
+        else:
+            w, acts, dep = mod.train_iteration(w, acts, x, target)
+        ok = jnp.argmax(acts[-1]) == p_trg
+        first_ok = jnp.where(it == 1, ok, first_ok)
+        return (w, m, acts, it, dep, ok, first_ok)
+
+    def cond(state):
+        _w, _m, _acts, it, dep, ok, _first = state
+        ok_eff = ok & (it > min_iter)
+        return (it == 0) | ((it <= max_iter) & ((dep > delta) | ~ok_eff))
+
+    init = (
+        weights,
+        dw,
+        acts0,
+        jnp.int32(0),
+        jnp.asarray(jnp.inf, dtype=ep0.dtype),
+        jnp.bool_(False),
+        jnp.bool_(False),
+    )
+    w, m, acts, it, dep, ok, first_ok = jax.lax.while_loop(cond, body, init)
+    final_ok = ok & (it > min_iter)
+    return SampleResult(w, m, ep0, it, dep, first_ok, final_ok, acts[-1])
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def run_sample(weights, x, *, model: str = "ann"):
+    """Forward pass only (``ann_kernel_run``/``snn_kernel_run``)."""
+    mod = snn if model == "snn" else ann
+    return mod.run(weights, x)

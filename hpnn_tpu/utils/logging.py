@@ -1,0 +1,85 @@
+"""Verbosity-gated logging, matching the reference's stdout protocol.
+
+The reference defines four log levels gated on a global verbosity
+(ref: /root/reference/include/libhpnn.h:95-122):
+
+* ``NN_DBG``   — verbosity > 2, prefix ``NN(DBG): ``
+* ``NN_OUT``   — verbosity > 1, prefix ``NN: ``
+* ``NN_COUT``  — verbosity > 1, no prefix (continuation tokens)
+* ``NN_WARN``  — verbosity > 0, prefix ``NN(WARN): ``
+* ``NN_ERROR`` — always,        prefix ``NN(ERR): ``
+
+plus rank-0-only output ``_OUT`` (ref: common.h:81-91).  The tutorial
+monitor scripts grep these exact tokens, so they are a de-facto metrics
+API and must be byte-stable.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_verbosity = 0
+
+
+def set_verbose(v: int) -> None:
+    global _verbosity
+    _verbosity = int(v)
+
+
+def inc_verbose() -> None:
+    global _verbosity
+    _verbosity += 1
+
+
+def dec_verbose() -> None:
+    global _verbosity
+    if _verbosity > 0:
+        _verbosity -= 1
+
+
+def get_verbose() -> int:
+    return _verbosity
+
+
+def _is_rank0() -> bool:
+    # Multi-process: only process 0 prints (reference: MPI rank 0 only).
+    from hpnn_tpu import runtime
+
+    return runtime.process_index() == 0
+
+
+def _out(fp, msg: str) -> None:
+    if _is_rank0():
+        fp.write(msg)
+
+
+def nn_dbg(fp, fmt: str, *args) -> None:
+    if _verbosity > 2:
+        _out(fp, "NN(DBG): " + (fmt % args if args else fmt))
+
+
+def nn_out(fp, fmt: str, *args) -> None:
+    if _verbosity > 1:
+        _out(fp, "NN: " + (fmt % args if args else fmt))
+
+
+def nn_cout(fp, fmt: str, *args) -> None:
+    if _verbosity > 1:
+        _out(fp, fmt % args if args else fmt)
+
+
+def nn_warn(fp, fmt: str, *args) -> None:
+    if _verbosity > 0:
+        _out(fp, "NN(WARN): " + (fmt % args if args else fmt))
+
+
+def nn_error(fp, fmt: str, *args) -> None:
+    _out(fp, "NN(ERR): " + (fmt % args if args else fmt))
+
+
+def nn_write(fp, fmt: str, *args) -> None:
+    _out(fp, fmt % args if args else fmt)
+
+
+def flush() -> None:
+    sys.stdout.flush()

@@ -1,0 +1,18 @@
+"""Test env: force CPU with 8 virtual devices (the reference's DEBUG
+3-GPU-contexts-on-one-device trick, SURVEY.md §4.3, done the JAX way)
+and enable x64 so CPU parity tests run in the reference's f64."""
+
+import os
+
+# Must be set before jax initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,135 @@
+"""ANN/SNN numerics vs an independent NumPy oracle.
+
+The oracle below implements the math spec from SURVEY.md §2.3-2.4
+directly in NumPy (f64), written independently of the JAX code paths,
+so agreement checks both against transcription errors.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from hpnn_tpu.models import ann, snn
+from hpnn_tpu.models.kernel import generate
+
+TINY = 1e-14
+
+
+def np_act(x):
+    return 2.0 / (1.0 + np.exp(-x)) - 1.0
+
+
+def np_forward_ann(ws, x):
+    acts = [x]
+    for w in ws:
+        acts.append(np_act(w @ acts[-1]))
+    return acts
+
+
+def np_forward_snn(ws, x):
+    acts = [x]
+    for w in ws[:-1]:
+        acts.append(np_act(w @ acts[-1]))
+    z = ws[-1] @ acts[-1]
+    e = np.exp(z - 1.0)
+    acts.append(e / (TINY + e.sum()))
+    return acts
+
+
+def np_bp_step_ann(ws, x, t, lr):
+    acts = np_forward_ann(ws, x)
+    ds = [None] * len(ws)
+    o = acts[-1]
+    ds[-1] = (t - o) * (-0.5 * (o * o - 1.0))
+    for l in range(len(ws) - 2, -1, -1):
+        v = acts[l + 1]
+        ds[l] = (ws[l + 1].T @ ds[l + 1]) * (-0.5 * (v * v - 1.0))
+    return [w + lr * np.outer(d, a) for w, d, a in zip(ws, ds, acts[:-1])]
+
+
+@pytest.fixture
+def setup():
+    k, _ = generate(3, 6, [5, 4], 3)
+    ws = [np.asarray(w) for w in k.weights]
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=6)
+    t = np.full(3, -1.0)
+    t[1] = 1.0
+    return ws, x, t
+
+
+def test_forward_matches_oracle(setup):
+    ws, x, t = setup
+    jw = tuple(jnp.asarray(w) for w in ws)
+    got = ann.forward(jw, jnp.asarray(x))
+    want = np_forward_ann(ws, x)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, atol=1e-14)
+
+
+def test_snn_forward_matches_oracle(setup):
+    ws, x, t = setup
+    jw = tuple(jnp.asarray(w) for w in ws)
+    got = snn.forward(jw, jnp.asarray(x))
+    want = np_forward_snn(ws, x)
+    np.testing.assert_allclose(np.asarray(got[-1]), want[-1], atol=1e-14)
+    assert abs(float(np.asarray(got[-1]).sum()) - 1.0) < 1e-10
+
+
+def test_error(setup):
+    ws, x, t = setup
+    out = np_forward_ann(ws, x)[-1]
+    got = float(ann.train_error(jnp.asarray(out), jnp.asarray(t)))
+    assert abs(got - 0.5 * ((t - out) ** 2).sum()) < 1e-14
+
+
+def test_snn_error(setup):
+    ws, x, t01 = setup
+    out = np_forward_snn(ws, x)[-1]
+    t = (t01 > 0).astype(float)
+    got = float(snn.train_error(jnp.asarray(out), jnp.asarray(t)))
+    want = -np.sum(t * np.log(out + TINY)) / out.shape[0]
+    assert abs(got - want) < 1e-14
+
+
+def test_bp_step_matches_oracle(setup):
+    ws, x, t = setup
+    jw = tuple(jnp.asarray(w) for w in ws)
+    acts = ann.forward(jw, jnp.asarray(x))
+    new_w, new_acts, dep = ann.train_iteration(jw, acts, jnp.asarray(x), jnp.asarray(t))
+    want = np_bp_step_ann(ws, x, t, ann.BP_LEARN_RATE)
+    for g, w in zip(new_w, want):
+        np.testing.assert_allclose(np.asarray(g), w, atol=1e-14)
+    # dEp = Ep - Epr with Epr computed from the UPDATED weights
+    ep = 0.5 * ((t - np_forward_ann(ws, x)[-1]) ** 2).sum()
+    epr = 0.5 * ((t - np_forward_ann(want, x)[-1]) ** 2).sum()
+    assert abs(float(dep) - (ep - epr)) < 1e-12
+
+
+def test_bpm_step_accumulates_momentum(setup):
+    ws, x, t = setup
+    jw = tuple(jnp.asarray(w) for w in ws)
+    dw = tuple(jnp.zeros_like(w) for w in jw)
+    acts = ann.forward(jw, jnp.asarray(x))
+    alpha = 0.2
+    w1, dw1, acts1, _ = ann.train_iteration_momentum(
+        jw, dw, acts, jnp.asarray(x), jnp.asarray(t), alpha
+    )
+    # first step: dw_new = alpha * lr * outer(d, v); W1 = W + lr*outer
+    acts0 = np_forward_ann(ws, x)
+    o = acts0[-1]
+    d_out = (t - o) * (-0.5 * (o * o - 1.0))
+    step = ann.BPM_LEARN_RATE * np.outer(d_out, acts0[-2])
+    np.testing.assert_allclose(np.asarray(w1[-1]), ws[-1] + step, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(dw1[-1]), alpha * step, atol=1e-14)
+
+
+def test_snn_output_delta_no_dact(setup):
+    ws, x, t01 = setup
+    t = (t01 > 0).astype(float)
+    jw = tuple(jnp.asarray(w) for w in ws)
+    acts = snn.forward(jw, jnp.asarray(x))
+    ds = snn.deltas(jw, acts, jnp.asarray(t))
+    np.testing.assert_allclose(
+        np.asarray(ds[-1]), t - np.asarray(acts[-1]), atol=1e-14
+    )

@@ -1,0 +1,110 @@
+"""Conf-file parser/dumper behavior, incl. the reference's quirks."""
+
+import io
+
+from hpnn_tpu import config
+from hpnn_tpu.config import NNTrain, NNType
+
+MNIST_CONF = """[name] MNIST
+[type] ANN
+[init] generate
+[seed] 10958
+[input] 784
+[hidden] 300
+[output] 10
+[train] BP
+[sample_dir] ./samples
+[test_dir] ./tests
+"""
+
+
+def _write(tmp_path, text, name="nn.conf"):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_parse_mnist_conf(tmp_path):
+    # small topology so generate is fast; same grammar as the tutorial conf
+    text = MNIST_CONF.replace("784", "12").replace("300", "7")
+    conf = config.load_conf(_write(tmp_path, text))
+    assert conf is not None
+    assert conf.name == "MNIST"
+    assert conf.type == NNType.ANN
+    assert conf.need_init is True
+    assert conf.seed == 10958
+    assert conf.train == NNTrain.BP
+    assert conf.samples == "./samples"
+    assert conf.tests == "./tests"
+    assert conf.kernel.n_inputs == 12
+    assert conf.kernel.hidden_sizes == (7,)
+    assert conf.kernel.n_outputs == 10
+
+
+def test_type_first_letter_only(tmp_path):
+    base = MNIST_CONF.replace("784", "4").replace("300", "3")
+    conf = config.load_conf(_write(tmp_path, base.replace("ANN", "SOMETHING")))
+    assert conf.type == NNType.SNN  # 'S' wins
+    conf = config.load_conf(_write(tmp_path, base.replace("ANN", "XYZ")))
+    assert conf.type == NNType.ANN  # default
+
+
+def test_train_modes(tmp_path):
+    base = MNIST_CONF.replace("784", "4").replace("300", "3")
+    for txt, mode in [
+        ("BP", NNTrain.BP),
+        ("BPM", NNTrain.BPM),
+        ("CG", NNTrain.CG),
+        ("SPLX", NNTrain.SPLX),
+    ]:
+        conf = config.load_conf(_write(tmp_path, base.replace("] BP", f"] {txt}")))
+        assert conf.train == mode, txt
+
+
+def test_multi_hidden(tmp_path):
+    text = MNIST_CONF.replace("784", "6").replace("[hidden] 300", "[hidden] 5 4 3")
+    conf = config.load_conf(_write(tmp_path, text))
+    assert conf.kernel.hidden_sizes == (5, 4, 3)
+
+
+def test_missing_type_fails(tmp_path):
+    text = MNIST_CONF.replace("784", "4").replace("300", "3")
+    text = text.replace("[type] ANN\n", "")
+    assert config.load_conf(_write(tmp_path, text)) is None
+
+
+def test_comment_strip(tmp_path):
+    text = MNIST_CONF.replace("784", "4").replace("300", "3")
+    text = text.replace("[sample_dir] ./samples", "[sample_dir] ./samples #comment")
+    conf = config.load_conf(_write(tmp_path, text))
+    assert conf.samples == "./samples"
+
+
+def test_dump_conf_format(tmp_path):
+    text = MNIST_CONF.replace("784", "4").replace("300", "3")
+    conf = config.load_conf(_write(tmp_path, text))
+    buf = io.StringIO()
+    config.dump_conf(conf, buf)
+    out = buf.getvalue()
+    # byte-format parity: plural tags, trailing space after hiddens list
+    assert "[name] MNIST\n" in out
+    assert "[type] ANN\n" in out
+    assert "[init] generate\n" in out
+    assert "[seed] 10958\n" in out
+    assert "[inputs] 4\n" in out
+    assert "[hiddens] 3 \n" in out
+    assert "[outputs] 10\n" in out
+    assert "[train] BP\n" in out
+
+
+def test_load_kernel_roundtrip_through_conf(tmp_path):
+    text = MNIST_CONF.replace("784", "4").replace("300", "3")
+    conf = config.load_conf(_write(tmp_path, text))
+    kpath = tmp_path / "kernel.opt"
+    with open(kpath, "w") as fp:
+        config.dump_kernel(conf, fp)
+    text2 = text.replace("[init] generate", f"[init] {kpath}")
+    conf2 = config.load_conf(_write(tmp_path, text2, "nn2.conf"))
+    assert conf2 is not None
+    assert conf2.need_init is False
+    assert conf2.kernel.n_inputs == 4

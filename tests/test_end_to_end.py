@@ -1,0 +1,104 @@
+"""End-to-end: conf → train_nn → kernel.opt → run_nn on tiny synthetic data.
+
+This is the framework's ``make check`` analogue (SURVEY.md §4.1): the
+CLIs run in-process over a small separable problem and must emit the
+reference's stdout token protocol and produce a reloadable kernel.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from hpnn_tpu.cli import run_nn, train_nn
+
+
+def _write_sample(path, x, t):
+    with open(path, "w") as fp:
+        fp.write(f"[input] {len(x)}\n")
+        fp.write(" ".join("%7.5f" % v for v in x) + "\n")
+        fp.write(f"[output] {len(t)}\n")
+        fp.write(" ".join("%.1f" % v for v in t) + "\n")
+
+
+@pytest.fixture
+def workdir(tmp_path, monkeypatch):
+    rng = np.random.default_rng(42)
+    samples = tmp_path / "samples"
+    samples.mkdir()
+    # two well-separated classes in 8-dim space
+    centers = np.array([[1.0] * 4 + [-1.0] * 4, [-1.0] * 4 + [1.0] * 4])
+    for i in range(20):
+        c = i % 2
+        x = centers[c] + 0.1 * rng.normal(size=8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        _write_sample(samples / f"s{i:05d}.txt", x, t)
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _conf(tmp_path, typ="ANN", train="BP", init="generate"):
+    text = (
+        "# test conf\n"
+        "[name] E2E\n"
+        f"[type] {typ}\n"
+        f"[init] {init}\n"
+        "[seed] 1234\n"
+        "[input] 8\n"
+        "[hidden] 6\n"
+        "[output] 2\n"
+        f"[train] {train}\n"
+        "[sample_dir] ./samples\n"
+        "[test_dir] ./samples\n"
+    )
+    p = tmp_path / "nn.conf"
+    p.write_text(text)
+    return str(p)
+
+
+@pytest.mark.parametrize(
+    "typ,train", [("ANN", "BP"), ("ANN", "BPM"), ("SNN", "BP"), ("SNN", "BPM")]
+)
+def test_train_and_run(workdir, capsys, typ, train):
+    conf = _conf(workdir, typ=typ, train=train)
+    rc = train_nn.main(["-v", "-v", "-v", conf])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert os.path.exists("kernel.tmp")
+    assert os.path.exists("kernel.opt")
+    # stdout token protocol
+    assert "NN: TRAINING FILE:" in out
+    assert re.search(r" init= *[0-9.]+", out)
+    assert re.search(r" N_ITER= *\d+", out)
+    assert re.search(r" final=", out)
+    if typ == "ANN" or train == "BPM":
+        assert ("SUCCESS!" in out) or ("FAIL!" in out)
+    else:
+        # SNN BP quirk: no SUCCESS!/FAIL! token
+        assert "SUCCESS!" not in out and "FAIL!" not in out
+
+    # now evaluate with the trained kernel
+    cont = workdir / "cont.conf"
+    cont.write_text(
+        open(conf).read().replace("[init] generate", "[init] kernel.opt")
+    )
+    rc = run_nn.main(["-v", "-v", str(cont)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "NN: TESTING FILE:" in out
+    passes = out.count("[PASS]")
+    fails = len(re.findall(r"\[FAIL idx=\d+\]", out))
+    assert passes + fails == 20
+    # trivially separable data: the trained net must classify it
+    assert passes >= 18, out
+
+
+def test_train_reproducible(workdir, capsys):
+    conf = _conf(workdir)
+    assert train_nn.main([conf]) == 0
+    k1 = open("kernel.opt").read()
+    assert train_nn.main([conf]) == 0
+    k2 = open("kernel.opt").read()
+    assert k1 == k2

@@ -1,0 +1,66 @@
+"""Kernel text format: round-trips and generation reproducibility."""
+
+import io
+
+import numpy as np
+import pytest
+
+from hpnn_tpu.fileio.kernel_format import KernelFormatError, load_kernel
+from hpnn_tpu.models import kernel as kernel_mod
+
+
+def test_generate_deterministic():
+    k1, s1 = kernel_mod.generate(10958, 4, [3], 2)
+    k2, s2 = kernel_mod.generate(10958, 4, [3], 2)
+    assert s1 == s2 == 10958
+    for a, b in zip(k1.weights, k2.weights):
+        np.testing.assert_array_equal(a, b)
+    assert k1.weights[0].shape == (3, 4)
+    assert k1.weights[1].shape == (2, 3)
+    # scaling bound: |w| <= 1/sqrt(M)
+    assert np.abs(k1.weights[0]).max() <= 1.0 / np.sqrt(4.0)
+    assert np.abs(k1.weights[1]).max() <= 1.0 / np.sqrt(3.0)
+
+
+def test_roundtrip(tmp_path):
+    k, _ = kernel_mod.generate(7, 5, [4, 3], 2)
+    p = tmp_path / "k.txt"
+    with open(p, "w") as fp:
+        kernel_mod.dump("test_net", k, fp)
+    name, k2 = kernel_mod.load(str(p))
+    assert name == "test_net"
+    assert len(k2.weights) == 3
+    for a, b in zip(k.weights, k2.weights):
+        # %17.15f keeps 15 decimals; values are < 1 so this is ~1e-15
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-15)
+
+
+def test_dump_format_tokens(tmp_path):
+    k, _ = kernel_mod.generate(1, 2, [3], 2)
+    buf = io.StringIO()
+    kernel_mod.dump("net", k, buf)
+    text = buf.getvalue()
+    lines = text.splitlines()
+    assert lines[0] == "[name] net"
+    assert lines[1] == "[param] 2 3 2"
+    assert lines[2] == "[input] 2"
+    assert lines[3] == "[hidden 1] 3"
+    assert lines[4] == "[neuron 1] 2"
+    assert "[output] 2" in lines
+    # weight rows: %17.15f formatting
+    row = lines[5].split()
+    assert all(len(tok.split(".")[1]) == 15 for tok in row)
+
+
+def test_load_rejects_bad_param(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("[name] x\n[param] 4\n")
+    with pytest.raises(KernelFormatError):
+        load_kernel(str(p))
+
+
+def test_validate():
+    k, _ = kernel_mod.generate(3, 4, [5], 2)
+    assert kernel_mod.validate(k)
+    bad = kernel_mod.Kernel((np.zeros((5, 4)), np.zeros((2, 9))))
+    assert not kernel_mod.validate(bad)
