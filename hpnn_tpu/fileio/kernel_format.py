@@ -129,7 +129,14 @@ def load_kernel(path: str) -> tuple[str, list[np.ndarray]]:
             i += 1
             if i >= len(lines):
                 raise KernelFormatError("EOF while reading neuron weights")
-            row = np.fromstring(lines[i], dtype=np.float64, sep=" ")
+            # first cur_m tokens only: the reference's GET_DOUBLE loop
+            # ignores anything after the M-th weight on the line
+            try:
+                row = np.array(lines[i].split()[:cur_m], dtype=np.float64)
+            except ValueError as exc:
+                raise KernelFormatError(
+                    f"layer {layer_idx}: bad weight token: {exc}"
+                ) from None
             if row.size < cur_m:
                 raise KernelFormatError(
                     f"layer {layer_idx}: neuron row has {row.size} < {cur_m} weights"

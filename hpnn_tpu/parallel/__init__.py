@@ -1,0 +1,17 @@
+"""Parallelism layer: device meshes + TP/DP training paths.
+
+Replaces the reference's MPI row-split + multi-GPU memory models
+(SURVEY.md §2.7) with `jax.sharding` over a Mesh:
+
+* ``mesh``  — mesh construction + layer-dim padding helpers.
+* ``tp``    — tensor parallelism: every layer's neuron (row) dimension
+  sharded over the ``model`` mesh axis, activations rebuilt with
+  ``lax.all_gather`` after each layer — the reference's
+  ``MPI_Allgather(MPI_IN_PLACE,...)`` per layer
+  (ref: /root/reference/src/ann.c:912-936) done the XLA way.
+* ``dp``    — data parallelism: batched samples over the ``data`` axis,
+  gradient allreduce with ``lax.pmean`` — the pod-scale path the
+  reference lacks (its MPI mode parallelizes *within* one sample).
+"""
+
+from hpnn_tpu.parallel import dp, mesh, tp  # noqa: F401

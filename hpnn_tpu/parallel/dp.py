@@ -1,0 +1,175 @@
+"""Data parallelism: batched minibatch SGD with gradient allreduce.
+
+This is the pod-scale training mode the reference does not have — its
+MPI mode shards *within* one sample (SURVEY.md §2.7 row "DP/PP/SP...:
+absent").  Per BASELINE.json, data parallelism over the ``data`` mesh
+axis with a ``lax.pmean`` gradient allreduce (the idiomatic descendant
+of ``MPI_Allreduce(MPI_SUM)``) is the new axis this framework adds.
+
+Semantics: one steepest-descent step per minibatch on the mean sample
+error, using the same learning rates as the reference's per-sample BP
+(the delta-rule update ``W += η·δ⊗v`` IS ``W -= η·∇Ep`` — the hand
+-derived dact identity is verified in tests/test_ann_numerics.py), so
+this mode's acceptance bar is final accuracy, not bitwise parity
+(SURVEY.md §7.6).
+
+Two implementations, same math:
+
+* :func:`make_dp_train_step` — explicit ``jax.shard_map`` + ``pmean``,
+  mirroring the MPI collective structure rank for rank.
+* :func:`make_gspmd_train_step` — sharding-annotated ``jit`` over a
+  ``(data, model)`` mesh (DP × TP hybrid): XLA inserts the collectives.
+  This is the flagship multi-chip path exercised by
+  ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hpnn_tpu.models import ann, snn
+from hpnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def sample_loss(weights, x, target, *, model: str = "ann"):
+    mod = snn if model == "snn" else ann
+    return mod.train_error(mod.forward(weights, x)[-1], target)
+
+
+def batch_loss(weights, X, T, *, model: str = "ann"):
+    """Mean per-sample error over the batch's leading axis."""
+    losses = jax.vmap(lambda x, t: sample_loss(weights, x, t, model=model))(X, T)
+    return jnp.mean(losses)
+
+
+def sgd_step(weights, grads, lr):
+    return tuple(w - lr * g for w, g in zip(weights, grads))
+
+
+def momentum_step(weights, dw, grads, lr, alpha):
+    """Batched analogue of the reference's BPM triad
+    ``dw += η·δ⊗v; W += dw; dw *= α`` (ref: src/ann.c:1982-2277)."""
+    new_w, new_dw = [], []
+    for w, m, g in zip(weights, dw, grads):
+        m = m - lr * g
+        new_w.append(w + m)
+        new_dw.append(alpha * m)
+    return tuple(new_w), tuple(new_dw)
+
+
+def default_lr(model: str, momentum: bool) -> float:
+    if model == "snn":
+        return snn.SNN_LEARN_RATE
+    return ann.BPM_LEARN_RATE if momentum else ann.BP_LEARN_RATE
+
+
+def make_dp_train_step(mesh, *, model: str = "ann", momentum: bool = False,
+                       lr: float | None = None, alpha: float = 0.2):
+    """Pure-DP step: weights replicated, batch sharded on ``data``,
+    explicit ``lax.pmean`` of the local mean gradients.
+
+    Batch size must be a multiple of the data-axis size.
+    """
+    if lr is None:
+        lr = default_lr(model, momentum)
+
+    def local_step(weights, dw, X_loc, T_loc):
+        grads = jax.grad(batch_loss)(weights, X_loc, T_loc, model=model)
+        grads = tuple(lax.pmean(g, DATA_AXIS) for g in grads)
+        if momentum:
+            weights, dw = momentum_step(weights, dw, grads, lr, alpha)
+        else:
+            weights = sgd_step(weights, grads, lr)
+        loss = lax.pmean(batch_loss(weights, X_loc, T_loc, model=model), DATA_AXIS)
+        return weights, dw, loss
+
+    rep = P()
+    batch = P(DATA_AXIS)
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(rep, rep, batch, batch),
+        out_specs=(rep, rep, rep),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def auto_kernel_shardings(mesh, weights):
+    """Per-layer NamedSharding: rows on the ``model`` axis when the row
+    count divides evenly, replicated otherwise.
+
+    JAX's explicit shardings demand divisibility, and padding is not an
+    option on this path (the unmasked ``snn.forward`` must never see
+    padded logits), so ragged layers simply replicate — never silently
+    wrong, at worst less sharded.  ``mesh.pad_kernel`` belongs to the
+    masked shard_map TP path only.
+    """
+    k = mesh.shape[MODEL_AXIS]
+    out = []
+    for w in weights:
+        if w.shape[0] % k == 0:
+            out.append(NamedSharding(mesh, P(MODEL_AXIS, None)))
+        else:
+            out.append(NamedSharding(mesh, P()))
+    return tuple(out)
+
+
+def place_kernel(weights, mesh):
+    """device_put every layer under its auto sharding."""
+    import jax.numpy as _jnp
+
+    shs = auto_kernel_shardings(mesh, [_jnp.asarray(w) for w in weights])
+    return tuple(
+        jax.device_put(_jnp.asarray(w), s) for w, s in zip(weights, shs)
+    )
+
+
+def make_gspmd_train_step(mesh, weights, *, model: str = "ann",
+                          momentum: bool = False, lr: float | None = None,
+                          alpha: float = 0.2, donate: bool = True):
+    """DP × TP hybrid step via sharding-annotated jit (GSPMD).
+
+    Weights: rows on ``model`` axis (per :func:`auto_kernel_shardings`);
+    batch: ``data`` axis.  XLA derives the all-gathers/reduce-scatters —
+    the whole of the reference's hand-written EXP-model gather/broadcast
+    machinery (ref: src/cuda_ann.cu:609-666,2860-2882) becomes compiler
+    output.  ``weights`` is used for its shapes only.
+    """
+    if lr is None:
+        lr = default_lr(model, momentum)
+
+    w_sh = auto_kernel_shardings(mesh, weights)
+    b_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    rep = NamedSharding(mesh, P())
+
+    def step(weights, dw, X, T):
+        grads = jax.grad(batch_loss)(weights, X, T, model=model)
+        if momentum:
+            weights, dw = momentum_step(weights, dw, grads, lr, alpha)
+        else:
+            weights = sgd_step(weights, grads, lr)
+        loss = batch_loss(weights, X, T, model=model)
+        return weights, dw, loss
+
+    dw_sh = w_sh if momentum else ()
+    return jax.jit(
+        step,
+        in_shardings=(w_sh, dw_sh, b_sh, b_sh),
+        out_shardings=(w_sh, dw_sh, rep),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def shard_batch(X, T, mesh):
+    """Place a (B, n) batch with B on the data axis."""
+    sh = NamedSharding(mesh, P(DATA_AXIS, None))
+    return jax.device_put(jnp.asarray(X), sh), jax.device_put(jnp.asarray(T), sh)
+
+
+def replicate_kernel(weights, mesh):
+    rep = NamedSharding(mesh, P())
+    return tuple(jax.device_put(jnp.asarray(w), rep) for w in weights)

@@ -1,0 +1,107 @@
+"""Device-mesh construction and layer-dim padding.
+
+The reference's distributed story is an MPI row-split with remainder
+rows computed redundantly by every rank
+(ref: /root/reference/src/ann.c:912-936,928-936) plus four multi-GPU
+memory models probed at init (ref: src/libhpnn.c:245-302).  On TPU both
+collapse into a single object: a ``jax.sharding.Mesh`` whose axes carry
+the parallelism kinds, with replication/slicing expressed as
+``NamedSharding`` specs and collectives riding ICI.
+
+Instead of redundant remainder rows we pad each layer's neuron count up
+to a multiple of the model-axis size (SURVEY.md §7 "Hard parts"): padded
+weight rows/columns are zero, which is a fixed point of the
+forward/backward/update math (act(0)=0, zero columns kill the
+transposed-gemv contribution, zero deltas keep pad rows zero), so
+training a padded kernel and stripping the padding afterwards is exactly
+equivalent — proven in tests/test_parallel.py.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: int = 1, n_model: int | None = None, devices=None):
+    """Build a ``(data, model)`` mesh over the available devices.
+
+    ``n_model`` defaults to (#devices / n_data).  The data axis is the
+    outer axis so data-parallel replicas sit on different hosts/slices
+    while model shards stay on adjacent chips (ICI-friendly).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n_model is None:
+        if n % n_data != 0:
+            raise ValueError(f"{n} devices not divisible by n_data={n_data}")
+        n_model = n // n_data
+    need = n_data * n_model
+    if need > n:
+        raise ValueError(f"mesh {n_data}x{n_model} needs {need} devices, have {n}")
+    dev = np.asarray(devices[:need]).reshape(n_data, n_model)
+    return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
+
+
+def kernel_specs(n_layers: int):
+    """Per-layer PartitionSpec: rows on the model axis, columns replicated.
+
+    This is the reference's row-block split (`red=N/n_tasks`,
+    ref: src/ann.c:912-920) as a sharding annotation.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return tuple(P(MODEL_AXIS, None) for _ in range(n_layers))
+
+
+def pad_up(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k
+
+
+def pad_kernel(weights: Sequence, k: int):
+    """Zero-pad every layer's row dim (and the next layer's column dim)
+    to a multiple of ``k``.  Returns (padded_weights, orig_row_sizes).
+
+    The input dim (columns of layer 0) is never padded: only rows are
+    sharded, exactly like the reference splits neurons, not inputs.
+    """
+    orig = tuple(int(w.shape[0]) for w in weights)
+    padded = []
+    prev_pad = 0  # column padding owed from the previous layer's rows
+    for w in weights:
+        w = np.asarray(w)
+        n, m = w.shape
+        np_rows = pad_up(n, k) - n
+        out = np.zeros((n + np_rows, m + prev_pad), dtype=w.dtype)
+        out[:n, :m] = w
+        padded.append(out)
+        prev_pad = np_rows
+    return tuple(padded), orig
+
+
+def unpad_kernel(weights: Sequence, orig_rows: Sequence[int]):
+    """Inverse of :func:`pad_kernel`."""
+    out = []
+    prev = None
+    for w, n in zip(weights, orig_rows):
+        w = np.asarray(w)
+        m = w.shape[1] if prev is None else prev
+        out.append(np.ascontiguousarray(w[:n, :m]))
+        prev = n
+    return tuple(out)
+
+
+def pad_vector(v, k: int):
+    v = np.asarray(v)
+    n = v.shape[0]
+    out = np.zeros((pad_up(n, k),), dtype=v.dtype)
+    out[:n] = v
+    return out

@@ -1,0 +1,251 @@
+"""Tensor parallelism: layer rows sharded over the ``model`` mesh axis.
+
+This is the TPU-native equivalent of the reference's MPI execution mode,
+collective for collective (SURVEY.md §2.7):
+
+| reference (MPI)                                   | here                    |
+|---------------------------------------------------|-------------------------|
+| row-block gemv per rank (src/ann.c:918-920)       | local ``w_loc @ v``     |
+| ``MPI_Allgather(IN_PLACE)`` of activations (:925) | ``lax.all_gather``      |
+| transposed-gemv col split + allgather (:1279-1592)| local ``w.T @ d_blk`` + ``lax.psum`` |
+| ``MPI_Allreduce`` of softmax dv (src/snn.c:303)   | masked full-vector sum (post-gather) |
+| weight-row update + allgather (:1630-1706)        | local rank-1 update, no collective |
+| remainder rows computed redundantly (:928-936)    | zero-padding to mesh multiples (parallel/mesh.py) |
+
+The whole per-sample convergence loop (train/loop.py) runs *inside* one
+``jax.shard_map`` + ``lax.while_loop``: weights never leave their shard,
+every iteration moves one activation vector per layer over ICI, and the
+host reads back five scalars per sample — where the reference re-entered
+MPI_Allgather per layer per iteration from host code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hpnn_tpu.models import ann, snn
+from hpnn_tpu.parallel.mesh import MODEL_AXIS, kernel_specs
+from hpnn_tpu.train.loop import SampleResult, target_argmax
+
+TINY = snn.TINY
+
+
+def _my_block(vec, k: int):
+    """My rank's row block of a full vector (block size static)."""
+    n = vec.shape[0] // k
+    i = lax.axis_index(MODEL_AXIS)
+    return lax.dynamic_slice(vec, (i * n,), (n,))
+
+
+def _out_mask(n_padded: int, n_out: int, dtype):
+    return (jnp.arange(n_padded) < n_out).astype(dtype)
+
+
+def forward_local(weights_loc, x, *, model: str, n_out: int):
+    """Per-shard forward; activations are rebuilt full after each layer.
+
+    Mirrors ``ann_kernel_run`` / ``snn_kernel_run``
+    (ref: src/ann.c:892-1242, src/snn.c:79-443) with the allgather as
+    ``lax.all_gather(..., tiled=True)``.  SNN: softmax quirks kept —
+    ``exp(z-1)`` (no max subtraction) and the TINY-seeded denominator
+    (ref: src/snn.c:282-335) — with padded logits masked out of the sum.
+    """
+    acts = [x]
+    v = x
+    last = len(weights_loc) - 1
+    for l, w in enumerate(weights_loc):
+        z_loc = w @ v
+        if model == "snn" and l == last:
+            e_loc = jnp.exp(z_loc - 1.0)
+            e = lax.all_gather(e_loc, MODEL_AXIS, tiled=True)
+            e = e * _out_mask(e.shape[0], n_out, e.dtype)
+            v = e / (TINY + jnp.sum(e))
+        else:
+            v = lax.all_gather(ann.act(z_loc), MODEL_AXIS, tiled=True)
+        acts.append(v)
+    return tuple(acts)
+
+
+def deltas_local(weights_loc, acts, target, *, model: str, k: int):
+    """Full delta vectors per layer from sharded weights.
+
+    Hidden-layer rule δ_l = (W_{l+1}ᵀ·δ_{l+1})·dact(v_l): each shard
+    contributes W_locᵀ @ (its block of δ_{l+1}), summed with
+    ``lax.psum`` — the column-split + allgather of the reference
+    (ref: src/ann.c:1279-1592) fused into one reduction.
+    """
+    if model == "snn":
+        d = target - acts[-1]  # softmax+CE shortcut (ref: src/snn.c:510-512)
+    else:
+        d = (target - acts[-1]) * ann.dact(acts[-1])
+    ds = [d]
+    for l in range(len(weights_loc) - 1, 0, -1):
+        part = weights_loc[l].T @ _my_block(ds[0], k)
+        ds.insert(0, lax.psum(part, MODEL_AXIS) * ann.dact(acts[l]))
+    return tuple(ds)
+
+
+def bp_update_local(weights_loc, acts, ds, lr, k: int):
+    """Rank-1 update on local rows only — no collective needed (the
+    reference re-allgathers updated weight rows, src/ann.c:1630-1706;
+    sharded weights make that a no-op)."""
+    return tuple(
+        w + lr * jnp.outer(_my_block(d, k), v)
+        for w, d, v in zip(weights_loc, ds, acts[:-1])
+    )
+
+
+def bpm_update_local(weights_loc, dw_loc, acts, ds, lr, alpha, k: int):
+    new_w, new_dw = [], []
+    for w, m, d, v in zip(weights_loc, dw_loc, ds, acts[:-1]):
+        m = m + lr * jnp.outer(_my_block(d, k), v)
+        new_w.append(w + m)
+        new_dw.append(alpha * m)
+    return tuple(new_w), tuple(new_dw)
+
+
+def _train_error(out, target, model: str, n_out: int):
+    if model == "snn":
+        # /n uses the REAL output count, not the padded one
+        return -jnp.sum(target * jnp.log(out + TINY)) / n_out
+    d = target - out
+    return 0.5 * jnp.sum(d * d)
+
+
+def _masked_argmax(out, n_out: int):
+    neg = jnp.full_like(out, -jnp.inf)
+    return jnp.argmax(jnp.where(jnp.arange(out.shape[0]) < n_out, out, neg))
+
+
+def train_sample_local(
+    weights_loc,
+    dw_loc,
+    x,
+    target,
+    alpha,
+    delta,
+    *,
+    model: str,
+    momentum: bool,
+    min_iter: int,
+    max_iter: int,
+    n_out: int,
+    k: int,
+):
+    """Per-shard body of the per-sample convergence loop (train/loop.py
+    semantics, ref: src/ann.c:2281-2467) over row-sharded weights."""
+    lr = snn.SNN_LEARN_RATE if model == "snn" else (
+        ann.BPM_LEARN_RATE if momentum else ann.BP_LEARN_RATE
+    )
+    acts0 = forward_local(weights_loc, x, model=model, n_out=n_out)
+    ep0 = _train_error(acts0[-1], target, model, n_out)
+    p_trg = target_argmax(target)
+
+    def one_iteration(w, m, acts):
+        ep = _train_error(acts[-1], target, model, n_out)
+        ds = deltas_local(w, acts, target, model=model, k=k)
+        if momentum:
+            w, m = bpm_update_local(w, m, acts, ds, lr, alpha, k)
+        else:
+            w = bp_update_local(w, acts, ds, lr, k)
+        acts = forward_local(w, x, model=model, n_out=n_out)
+        epr = _train_error(acts[-1], target, model, n_out)
+        return w, m, acts, ep - epr
+
+    def body(state):
+        w, m, acts, it, _dep, _ok, first_ok = state
+        it = it + 1
+        w, m, acts, dep = one_iteration(w, m, acts)
+        ok = _masked_argmax(acts[-1], n_out) == p_trg
+        first_ok = jnp.where(it == 1, ok, first_ok)
+        return (w, m, acts, it, dep, ok, first_ok)
+
+    def cond(state):
+        _w, _m, _acts, it, dep, ok, _first = state
+        ok_eff = ok & (it > min_iter)
+        return (it == 0) | ((it <= max_iter) & ((dep > delta) | ~ok_eff))
+
+    init = (
+        weights_loc,
+        dw_loc,
+        acts0,
+        jnp.int32(0),
+        jnp.asarray(jnp.inf, dtype=ep0.dtype),
+        jnp.bool_(False),
+        jnp.bool_(False),
+    )
+    w, m, acts, it, dep, ok, first_ok = lax.while_loop(cond, body, init)
+    final_ok = ok & (it > min_iter)
+    return SampleResult(w, m, ep0, it, dep, first_ok, final_ok, acts[-1])
+
+
+def make_train_fn(
+    mesh,
+    n_layers: int,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int,
+    max_iter: int,
+    n_out: int,
+):
+    """Jitted TP per-sample trainer over a mesh.
+
+    Weights/dw must be sharded ``P(MODEL_AXIS, None)`` per layer (see
+    :func:`shard_kernel`); x/target replicated.  Row counts must be
+    multiples of the model-axis size (use ``mesh.pad_kernel``).
+    """
+    k = mesh.shape[MODEL_AXIS]
+    wspec = kernel_specs(n_layers)
+    dspec = wspec if momentum else ()
+    vec = P(None)
+    scal = P()
+
+    fn = functools.partial(
+        train_sample_local,
+        model=model,
+        momentum=momentum,
+        min_iter=min_iter,
+        max_iter=max_iter,
+        n_out=n_out,
+        k=k,
+    )
+    sharded = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(wspec, dspec, vec, vec, scal, scal),
+        out_specs=SampleResult(wspec, dspec, scal, scal, scal, scal, scal, vec),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def make_run_fn(mesh, n_layers: int, *, model: str = "ann", n_out: int):
+    """Jitted TP forward pass (``ann/snn_kernel_run`` over the mesh)."""
+    wspec = kernel_specs(n_layers)
+    rep = P(None)
+
+    def f(weights_loc, x):
+        return forward_local(weights_loc, x, model=model, n_out=n_out)[-1]
+
+    sharded = jax.shard_map(
+        f, mesh=mesh, in_specs=(wspec, rep), out_specs=rep, check_vma=False
+    )
+    return jax.jit(sharded)
+
+
+def shard_kernel(weights, mesh):
+    """Place per-layer weights with rows on the model axis."""
+    return tuple(
+        jax.device_put(jnp.asarray(w), NamedSharding(mesh, s))
+        for w, s in zip(weights, kernel_specs(len(weights)))
+    )
+
+
+def replicate(x, mesh):
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(None)))

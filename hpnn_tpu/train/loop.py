@@ -56,10 +56,13 @@ class SampleResult(NamedTuple):
     out: jax.Array       # final output vector
 
 
-def _target_argmax(target):
+def target_argmax(target):
     """p_trg: LAST index with target exactly 1.0, else 0 (ref C loop)."""
     n = target.shape[0]
     return jnp.max(jnp.where(target == 1.0, jnp.arange(n), 0))
+
+
+_target_argmax = target_argmax
 
 
 @functools.partial(

@@ -4,8 +4,10 @@ and enable x64 so CPU parity tests run in the reference's f64."""
 
 import os
 
-# Must be set before jax initializes.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes.  Force CPU even when the outer
+# environment selects an accelerator platform (e.g. JAX_PLATFORMS=axon):
+# the suite is written for 8 virtual f64 CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,4 +17,8 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+# jax may already be imported at interpreter startup (site hook) with an
+# accelerator platform selected; the backend only initializes on first
+# use, so overriding the config here still wins.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

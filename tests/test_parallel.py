@@ -1,0 +1,272 @@
+"""TP/DP parallelism tests on the 8-virtual-CPU-device mesh.
+
+The oracle is the reference's own consistency criterion: every backend
+combination must agree numerically (abs-sum ≤1e-12-ish in f64,
+ref: /root/reference/ChangeLog:33-38).  Here the "backends" are the
+single-device jitted path (tests/test_ann_numerics.py's subject) and
+the sharded TP/DP paths over a faked 8-device mesh — the JAX version of
+the reference's DEBUG 3-GPU-contexts-on-one-device trick (SURVEY.md §4.3).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpnn_tpu.models import ann, kernel as kernel_mod, snn
+from hpnn_tpu.parallel import dp, mesh as mesh_mod, tp
+from hpnn_tpu.train import loop
+
+
+def _make_kernel(seed, n_in, hiddens, n_out):
+    k, _ = kernel_mod.generate(seed, n_in, hiddens, n_out)
+    return tuple(jnp.asarray(w) for w in k.weights)
+
+
+def _sample(seed, n_in, n_out, hot=3):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.uniform(-1, 1, n_in))
+    t = jnp.asarray(np.where(np.arange(n_out) == hot, 1.0, -1.0))
+    return x, t
+
+
+def _sample_snn(seed, n_in, n_out, hot=3):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.uniform(-1, 1, n_in))
+    t = jnp.asarray(np.where(np.arange(n_out) == hot, 1.0, 0.0))
+    return x, t
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return mesh_mod.make_mesh(n_data=2, n_model=4)
+
+
+@pytest.mark.parametrize("model,momentum", [
+    ("ann", False), ("ann", True), ("snn", False), ("snn", True),
+])
+def test_tp_train_sample_matches_single_device(mesh4, model, momentum):
+    """TP over 4 model shards == single-device trainer, bit-for-bit-ish."""
+    n_in, hiddens, n_out = 12, [16, 8], 8  # divisible by 4
+    weights = _make_kernel(1234, n_in, hiddens, n_out)
+    x, t = (_sample_snn if model == "snn" else _sample)(7, n_in, n_out)
+    min_it, max_it = 5, 40  # keep runtimes small; same loop structure
+
+    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    ref = loop.train_sample(
+        weights, dw, x, t, 0.2, 1e-6,
+        model=model, momentum=momentum, min_iter=min_it, max_iter=max_it,
+    )
+
+    fn = tp.make_train_fn(
+        mesh4, len(weights), model=model, momentum=momentum,
+        min_iter=min_it, max_iter=max_it, n_out=n_out,
+    )
+    w_sh = tp.shard_kernel(weights, mesh4)
+    dw_sh = tp.shard_kernel(dw, mesh4) if momentum else ()
+    got = fn(w_sh, dw_sh, tp.replicate(x, mesh4), tp.replicate(t, mesh4),
+             jnp.asarray(0.2), jnp.asarray(1e-6))
+
+    assert int(got.n_iter) == int(ref.n_iter)
+    assert bool(got.first_ok) == bool(ref.first_ok)
+    assert bool(got.final_ok) == bool(ref.final_ok)
+    np.testing.assert_allclose(float(got.ep0), float(ref.ep0), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(got.out), np.asarray(ref.out),
+                               atol=1e-11)
+    for a, b in zip(got.weights, ref.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-11)
+
+
+def test_tp_padded_kernel_equivalence(mesh4):
+    """Padding layer dims to mesh multiples doesn't change the math."""
+    n_in, hiddens, n_out = 10, [7, 5], 3  # nothing divisible by 4
+    weights = _make_kernel(99, n_in, hiddens, n_out)
+    x, t = _sample(3, n_in, n_out, hot=1)
+    min_it, max_it = 3, 25
+
+    ref = loop.train_sample(
+        weights, (), x, t, 0.2, 1e-6,
+        model="ann", momentum=False, min_iter=min_it, max_iter=max_it,
+    )
+
+    k = 4
+    padded, orig_rows = mesh_mod.pad_kernel(weights, k)
+    t_pad = mesh_mod.pad_vector(np.asarray(t), k)
+    # ANN target padding uses 0 (outside the argmax mask anyway)
+    fn = tp.make_train_fn(
+        mesh4, len(weights), model="ann", momentum=False,
+        min_iter=min_it, max_iter=max_it, n_out=n_out,
+    )
+    got = fn(
+        tp.shard_kernel(padded, mesh4), (),
+        tp.replicate(x, mesh4), tp.replicate(jnp.asarray(t_pad), mesh4),
+        jnp.asarray(0.2), jnp.asarray(1e-6),
+    )
+    assert int(got.n_iter) == int(ref.n_iter)
+    un = mesh_mod.unpad_kernel(got.weights, orig_rows)
+    for a, b in zip(un, ref.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-11)
+    np.testing.assert_allclose(
+        np.asarray(got.out)[:n_out], np.asarray(ref.out), atol=1e-11
+    )
+
+
+def test_tp_padded_snn_equivalence(mesh4):
+    """SNN softmax masking: padded logits must not pollute dv."""
+    n_in, hiddens, n_out = 10, [6], 5
+    weights = _make_kernel(4242, n_in, hiddens, n_out)
+    x, t = _sample_snn(11, n_in, n_out, hot=2)
+    min_it, max_it = 3, 20
+
+    ref = loop.train_sample(
+        weights, (), x, t, 0.2, 1e-6,
+        model="snn", momentum=False, min_iter=min_it, max_iter=max_it,
+    )
+    k = 4
+    padded, orig_rows = mesh_mod.pad_kernel(weights, k)
+    t_pad = mesh_mod.pad_vector(np.asarray(t), k)
+    fn = tp.make_train_fn(
+        mesh4, len(weights), model="snn", momentum=False,
+        min_iter=min_it, max_iter=max_it, n_out=n_out,
+    )
+    got = fn(
+        tp.shard_kernel(padded, mesh4), (),
+        tp.replicate(x, mesh4), tp.replicate(jnp.asarray(t_pad), mesh4),
+        jnp.asarray(0.2), jnp.asarray(1e-6),
+    )
+    assert int(got.n_iter) == int(ref.n_iter)
+    un = mesh_mod.unpad_kernel(got.weights, orig_rows)
+    for a, b in zip(un, ref.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-11)
+
+
+def test_tp_run_fn(mesh4):
+    n_in, hiddens, n_out = 12, [8], 4
+    weights = _make_kernel(7, n_in, hiddens, n_out)
+    x, _ = _sample(5, n_in, n_out)
+    ref = ann.run(weights, x)
+    fn = tp.make_run_fn(mesh4, len(weights), model="ann", n_out=n_out)
+    got = fn(tp.shard_kernel(weights, mesh4), tp.replicate(x, mesh4))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-14)
+
+
+# ---------------------------------------------------------------- DP
+
+
+def _batch(seed, B, n_in, n_out, snn_targets=False):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (B, n_in))
+    hots = rng.randint(0, n_out, B)
+    lo = 0.0 if snn_targets else -1.0
+    T = np.full((B, n_out), lo)
+    T[np.arange(B), hots] = 1.0
+    return jnp.asarray(X), jnp.asarray(T)
+
+
+def test_dp_step_matches_host_math():
+    """Explicit shard_map+pmean step == single-device batched grad step."""
+    m = mesh_mod.make_mesh(n_data=8, n_model=1)
+    weights = _make_kernel(555, 6, [10], 4)
+    X, T = _batch(1, 16, 6, 4)
+
+    step = dp.make_dp_train_step(m, model="ann", momentum=False)
+    Xs, Ts = dp.shard_batch(X, T, m)
+    w_rep = dp.replicate_kernel(weights, m)
+    got_w, _, got_loss = step(w_rep, (), Xs, Ts)
+
+    grads = jax.grad(dp.batch_loss)(weights, X, T, model="ann")
+    want_w = dp.sgd_step(weights, grads, ann.BP_LEARN_RATE)
+    for a, b in zip(got_w, want_w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+    want_loss = dp.batch_loss(want_w, X, T, model="ann")
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-12)
+
+
+def test_dp_grad_equals_delta_rule():
+    """-∇Ep reproduces the reference's hand-derived delta updates."""
+    weights = _make_kernel(77, 5, [6], 3)
+    x, t = _sample(9, 5, 3, hot=0)
+    acts = ann.forward(weights, x)
+    ds = ann.deltas(weights, acts, t)
+    manual = ann.bp_update(weights, acts, ds, ann.BP_LEARN_RATE)
+    grads = jax.grad(dp.sample_loss)(weights, x, t, model="ann")
+    auto = dp.sgd_step(weights, grads, ann.BP_LEARN_RATE)
+    for a, b in zip(manual, auto):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-14)
+
+
+def test_dp_grad_equals_delta_rule_snn():
+    weights = _make_kernel(78, 5, [6], 3)
+    x, t = _sample_snn(10, 5, 3, hot=1)
+    acts = snn.forward(weights, x)
+    ds = snn.deltas(weights, acts, t)
+    manual = ann.bp_update(weights, acts, ds, snn.SNN_LEARN_RATE / t.shape[0])
+    # CE error divides by N; the reference's δ=t−o absorbs it (the C code
+    # uses the un-normalized δ with η — SURVEY.md §2.4 S3/S4), so the
+    # autodiff gradient of (Ep = CE/N) equals δ/N.
+    grads = jax.grad(dp.sample_loss)(weights, x, t, model="snn")
+    auto = dp.sgd_step(weights, grads, snn.SNN_LEARN_RATE)
+    for a, b in zip(manual, auto):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-13)
+
+
+def test_gspmd_hybrid_step(mesh4):
+    """DP×TP sharded jit compiles, runs, and matches replicated math."""
+    n_in, hiddens, n_out = 12, [16, 8], 8
+    weights = _make_kernel(2024, n_in, hiddens, n_out)
+    X, T = _batch(2, 8, n_in, n_out)
+
+    step = dp.make_gspmd_train_step(
+        mesh4, weights, model="ann", momentum=False, donate=False
+    )
+    w_sh = dp.place_kernel(weights, mesh4)
+    Xs, Ts = dp.shard_batch(X, T, mesh4)
+    got_w, _, got_loss = step(w_sh, (), Xs, Ts)
+
+    grads = jax.grad(dp.batch_loss)(weights, X, T, model="ann")
+    want_w = dp.sgd_step(weights, grads, ann.BP_LEARN_RATE)
+    for a, b in zip(got_w, want_w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+
+
+def test_gspmd_uneven_snn_unpadded(mesh4):
+    """GSPMD shards non-divisible dims itself — no pad_kernel, and the
+    unmasked snn.forward stays correct because no pad rows exist."""
+    n_in, hiddens, n_out = 7, [10], 5  # nothing divisible by 4
+    weights = _make_kernel(61, n_in, hiddens, n_out)
+    X, T = _batch(4, 8, n_in, n_out, snn_targets=True)
+
+    step = dp.make_gspmd_train_step(
+        mesh4, weights, model="snn", momentum=False, donate=False
+    )
+    w_sh = dp.place_kernel(weights, mesh4)
+    Xs, Ts = dp.shard_batch(X, T, mesh4)
+    got_w, _, _ = step(w_sh, (), Xs, Ts)
+
+    grads = jax.grad(dp.batch_loss)(weights, X, T, model="snn")
+    want_w = dp.sgd_step(weights, grads, snn.SNN_LEARN_RATE)
+    for a, b in zip(got_w, want_w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+
+
+def test_gspmd_momentum_step(mesh4):
+    weights = _make_kernel(31, 12, [8], 4)
+    X, T = _batch(3, 8, 12, 4)
+    dw = tuple(jnp.zeros_like(w) for w in weights)
+
+    step = dp.make_gspmd_train_step(
+        mesh4, weights, model="ann", momentum=True, donate=False
+    )
+    w_sh = dp.place_kernel(weights, mesh4)
+    dw_sh = dp.place_kernel(dw, mesh4)
+    Xs, Ts = dp.shard_batch(X, T, mesh4)
+    got_w, got_dw, _ = step(w_sh, dw_sh, Xs, Ts)
+
+    grads = jax.grad(dp.batch_loss)(weights, X, T, model="ann")
+    want_w, want_dw = dp.momentum_step(
+        weights, dw, grads, ann.BPM_LEARN_RATE, 0.2
+    )
+    for a, b in zip(got_w, want_w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+    for a, b in zip(got_dw, want_dw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
