@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Headline benchmark: per-sample BP training throughput, MNIST-shaped.
+
+Protocol (mirrors the reference MNIST tutorial shape and training mode,
+ref: /root/reference/tutorials/mnist/tutorial.bash:125-137): a
+784-300-10 ANN, `[train] BP`, seed 10958, and 64 synthetic MNIST-like
+samples (sparse 0..255 pixels, one-hot ±1 targets, fixed RNG) each
+trained to the reference's convergence criterion (δ=1e-6, 31..102399
+iterations, ref: include/libhpnn.h:67-74).
+
+Baseline: the SAME workload run by a locally-built reference
+(gcc -O2 -fopenmp -D_OMP, the best build this toolchain allows — no
+cblas headers, no MPI) with the tutorial's `-O4 -B4` flags.  Measured
+2026-07-29: 64 samples / 70.3 s = 0.910 samples/s, 137,926 total inner
+iterations (ours: 139,066 — within 1%, so wall-clock per sample is an
+apples-to-apples work comparison).  See BASELINE.md.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/s", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = 0.910  # measured reference, see module docstring
+N_SAMPLES = 64
+
+
+def make_workload():
+    rng = np.random.RandomState(12345)
+    samples = []
+    for i in range(N_SAMPLES):
+        x = np.zeros(784)
+        nz = rng.choice(784, size=150, replace=False)
+        x[nz] = rng.uniform(0, 255, size=150)
+        t = np.full(10, -1.0)
+        t[i % 10] = 1.0
+        samples.append((x, t))
+    return samples
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.train import loop
+
+    dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    samples = make_workload()
+    k, _ = kernel_mod.generate(10958, 784, [300], 10)
+    weights0 = tuple(jnp.asarray(np.asarray(w), dtype=dtype) for w in k.weights)
+
+    def one(weights, x, t):
+        return loop.train_sample(
+            weights,
+            (),
+            jnp.asarray(x, dtype=dtype),
+            jnp.asarray(t, dtype=dtype),
+            0.2,
+            loop.DELTA_BP,
+            model="ann",
+            momentum=False,
+            min_iter=loop.MIN_BP_ITER,
+            max_iter=loop.MAX_BP_ITER,
+        )
+
+    # warmup: compile the while_loop trainer for this topology
+    r = one(weights0, *samples[0])
+    jax.block_until_ready(r.weights)
+
+    weights = weights0
+    total_iters = 0
+    t0 = time.perf_counter()
+    for x, t in samples:
+        r = one(weights, x, t)
+        weights = r.weights
+        total_iters += int(r.n_iter)  # host sync, like the token prints
+    jax.block_until_ready(weights)
+    dt = time.perf_counter() - t0
+
+    sps = N_SAMPLES / dt
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_synth_bp_train_throughput",
+                "value": round(sps, 3),
+                "unit": "samples/s",
+                "vs_baseline": round(sps / BASELINE_SAMPLES_PER_SEC, 3),
+                "total_inner_iters": total_iters,
+                "wall_s": round(dt, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

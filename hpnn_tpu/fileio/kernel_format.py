@@ -141,7 +141,7 @@ def load_kernel(path: str) -> tuple[str, list[np.ndarray]]:
                 raise KernelFormatError(
                     f"layer {layer_idx}: neuron row has {row.size} < {cur_m} weights"
                 )
-            rows.append(row[:cur_m])
+            rows.append(row)
         i += 1
     _flush()
     if len(weights) != len(layer_sizes):

@@ -120,11 +120,9 @@ def auto_kernel_shardings(mesh, weights):
 
 def place_kernel(weights, mesh):
     """device_put every layer under its auto sharding."""
-    import jax.numpy as _jnp
-
-    shs = auto_kernel_shardings(mesh, [_jnp.asarray(w) for w in weights])
+    shs = auto_kernel_shardings(mesh, weights)
     return tuple(
-        jax.device_put(_jnp.asarray(w), s) for w, s in zip(weights, shs)
+        jax.device_put(jnp.asarray(w), s) for w, s in zip(weights, shs)
     )
 
 

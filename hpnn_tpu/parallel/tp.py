@@ -30,7 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from hpnn_tpu.models import ann, snn
 from hpnn_tpu.parallel.mesh import MODEL_AXIS, kernel_specs
-from hpnn_tpu.train.loop import SampleResult, target_argmax
+from hpnn_tpu.train.loop import SampleResult, convergence_loop, target_argmax
 
 TINY = snn.TINY
 
@@ -144,7 +144,6 @@ def train_sample_local(
     )
     acts0 = forward_local(weights_loc, x, model=model, n_out=n_out)
     ep0 = _train_error(acts0[-1], target, model, n_out)
-    p_trg = target_argmax(target)
 
     def one_iteration(w, m, acts):
         ep = _train_error(acts[-1], target, model, n_out)
@@ -157,31 +156,18 @@ def train_sample_local(
         epr = _train_error(acts[-1], target, model, n_out)
         return w, m, acts, ep - epr
 
-    def body(state):
-        w, m, acts, it, _dep, _ok, first_ok = state
-        it = it + 1
-        w, m, acts, dep = one_iteration(w, m, acts)
-        ok = _masked_argmax(acts[-1], n_out) == p_trg
-        first_ok = jnp.where(it == 1, ok, first_ok)
-        return (w, m, acts, it, dep, ok, first_ok)
-
-    def cond(state):
-        _w, _m, _acts, it, dep, ok, _first = state
-        ok_eff = ok & (it > min_iter)
-        return (it == 0) | ((it <= max_iter) & ((dep > delta) | ~ok_eff))
-
-    init = (
+    return convergence_loop(
+        one_iteration,
+        lambda out: _masked_argmax(out, n_out),
         weights_loc,
         dw_loc,
         acts0,
-        jnp.int32(0),
-        jnp.asarray(jnp.inf, dtype=ep0.dtype),
-        jnp.bool_(False),
-        jnp.bool_(False),
+        ep0,
+        target_argmax(target),
+        delta,
+        min_iter=min_iter,
+        max_iter=max_iter,
     )
-    w, m, acts, it, dep, ok, first_ok = lax.while_loop(cond, body, init)
-    final_ok = ok & (it > min_iter)
-    return SampleResult(w, m, ep0, it, dep, first_ok, final_ok, acts[-1])
 
 
 def make_train_fn(

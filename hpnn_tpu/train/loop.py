@@ -62,7 +62,54 @@ def target_argmax(target):
     return jnp.max(jnp.where(target == 1.0, jnp.arange(n), 0))
 
 
-_target_argmax = target_argmax
+def convergence_loop(
+    one_iteration,
+    out_argmax,
+    weights,
+    dw,
+    acts0,
+    ep0,
+    p_trg,
+    delta,
+    *,
+    min_iter: int,
+    max_iter: int,
+):
+    """The reference's do-while convergence skeleton, parameterized by
+    the per-iteration step (single-device or TP-sharded).
+
+    ``one_iteration(w, m, acts) -> (w, m, acts, dEp)``;
+    ``out_argmax(out) -> index`` (masked for padded TP kernels).
+    All C-parity quirks live here and only here: the it==0 bootstrap,
+    the max-iter break before the min-iter clamp, first_ok captured at
+    it==1, and final_ok = ok & (it > min_iter) applied after the loop.
+    """
+
+    def body(state):
+        w, m, acts, it, _dep, _ok, first_ok = state
+        it = it + 1
+        w, m, acts, dep = one_iteration(w, m, acts)
+        ok = out_argmax(acts[-1]) == p_trg
+        first_ok = jnp.where(it == 1, ok, first_ok)
+        return (w, m, acts, it, dep, ok, first_ok)
+
+    def cond(state):
+        _w, _m, _acts, it, dep, ok, _first = state
+        ok_eff = ok & (it > min_iter)
+        return (it == 0) | ((it <= max_iter) & ((dep > delta) | ~ok_eff))
+
+    init = (
+        weights,
+        dw,
+        acts0,
+        jnp.int32(0),
+        jnp.asarray(jnp.inf, dtype=ep0.dtype),
+        jnp.bool_(False),
+        jnp.bool_(False),
+    )
+    w, m, acts, it, dep, ok, first_ok = jax.lax.while_loop(cond, body, init)
+    final_ok = ok & (it > min_iter)
+    return SampleResult(w, m, ep0, it, dep, first_ok, final_ok, acts[-1])
 
 
 @functools.partial(
@@ -85,38 +132,25 @@ def train_sample(
     mod = snn if model == "snn" else ann
     acts0 = mod.forward(weights, x)
     ep0 = mod.train_error(acts0[-1], target)
-    p_trg = _target_argmax(target)
 
-    def body(state):
-        w, m, acts, it, _dep, _ok, first_ok = state
-        it = it + 1
+    def one_iteration(w, m, acts):
         if momentum:
-            w, m, acts, dep = mod.train_iteration_momentum(
-                w, m, acts, x, target, alpha
-            )
-        else:
-            w, acts, dep = mod.train_iteration(w, acts, x, target)
-        ok = jnp.argmax(acts[-1]) == p_trg
-        first_ok = jnp.where(it == 1, ok, first_ok)
-        return (w, m, acts, it, dep, ok, first_ok)
+            return mod.train_iteration_momentum(w, m, acts, x, target, alpha)
+        w, acts, dep = mod.train_iteration(w, acts, x, target)
+        return w, m, acts, dep
 
-    def cond(state):
-        _w, _m, _acts, it, dep, ok, _first = state
-        ok_eff = ok & (it > min_iter)
-        return (it == 0) | ((it <= max_iter) & ((dep > delta) | ~ok_eff))
-
-    init = (
+    return convergence_loop(
+        one_iteration,
+        jnp.argmax,
         weights,
         dw,
         acts0,
-        jnp.int32(0),
-        jnp.asarray(jnp.inf, dtype=ep0.dtype),
-        jnp.bool_(False),
-        jnp.bool_(False),
+        ep0,
+        target_argmax(target),
+        delta,
+        min_iter=min_iter,
+        max_iter=max_iter,
     )
-    w, m, acts, it, dep, ok, first_ok = jax.lax.while_loop(cond, body, init)
-    final_ok = ok & (it > min_iter)
-    return SampleResult(w, m, ep0, it, dep, first_ok, final_ok, acts[-1])
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
