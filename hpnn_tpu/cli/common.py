@@ -44,6 +44,61 @@ def dump_help(prog: str) -> None:
     w("***********************************\n")
 
 
+def extract_long_opts(argv: list[str], *, flags=(), valued=()):
+    """Pull ``--name [value]`` extensions out of argv before the
+    reference flag grammar runs.  New, TPU-side options only — the
+    single-dash grammar stays byte-compatible with the C CLIs.
+
+    Returns (remaining_argv, opts dict) or (None, None) on error.
+    """
+    out = {}
+    rest = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg.startswith("--"):
+            name = arg[2:]
+            val = None
+            if "=" in name:
+                name, val = name.split("=", 1)
+            if name in flags and val is None:
+                out[name] = True
+            elif name in valued:
+                if val is None:
+                    i += 1
+                    if i >= len(argv):
+                        sys.stderr.write(f"syntax error: --{name} needs a value\n")
+                        return None, None
+                    val = argv[i]
+                out[name] = val
+            else:
+                sys.stderr.write(f"syntax error: unrecognized option --{name}\n")
+                return None, None
+        else:
+            rest.append(arg)
+        i += 1
+    return rest, out
+
+
+def validate_long_opts(opts: dict) -> bool:
+    """Value checks for the TPU-side long options; prints the CLI's
+    usual ``syntax error`` style instead of raising."""
+    for name in ("batch", "epochs"):
+        v = opts.get(name)
+        if v is None or v is True:
+            continue
+        if not str(v).isdigit() or int(v) < 1:
+            sys.stderr.write(f"syntax error: bad --{name} parameter!\n")
+            return False
+    mesh = opts.get("mesh")
+    if mesh is not None:
+        parts = str(mesh).lower().split("x")
+        if len(parts) != 2 or not all(p.isdigit() and int(p) >= 1 for p in parts):
+            sys.stderr.write("syntax error: bad --mesh parameter (want DxM)!\n")
+            return False
+    return True
+
+
 def parse_args(argv: list[str], prog: str) -> str | None:
     """Apply flags to the runtime; return the conf filename or None.
 

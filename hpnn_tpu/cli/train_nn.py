@@ -17,6 +17,12 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     common.install_sigpipe_handler()
     runtime.init_all(1)
+    argv, opts = common.extract_long_opts(
+        argv, valued=("batch", "epochs", "mesh")
+    )
+    if argv is None or not common.validate_long_opts(opts):
+        runtime.deinit_all()
+        return -1
     filename = common.parse_args(argv, "train_nn")
     if filename is None:
         runtime.deinit_all()
@@ -33,7 +39,18 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
         runtime.deinit_all()
         return -1
-    if not driver.train_kernel(conf):
+    if "batch" in opts:
+        from hpnn_tpu.train import batch as batch_mod
+
+        ok = batch_mod.train_kernel_batched(
+            conf,
+            batch_size=int(opts["batch"]),
+            epochs=int(opts.get("epochs", "1")),
+            mesh_spec=opts.get("mesh"),
+        )
+    else:
+        ok = driver.train_kernel(conf)
+    if not ok:
         sys.stderr.write("FAILED to train kernel!\n")
         runtime.deinit_all()
         return -1

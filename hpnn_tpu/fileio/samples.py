@@ -35,24 +35,64 @@ def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
             n = _count_after(line, "[input")
             if n is None or n == 0 or i + 1 >= len(lines):
                 return None
-            vin = np.fromstring(lines[i + 1], dtype=np.float64, sep=" ")
-            if vin.size < n:
+            vin = _parse_row(lines[i + 1], n)
+            if vin is None:
                 return None
-            vin = vin[:n]
             i += 1
         elif "[output" in line:
             n = _count_after(line, "[output")
             if n is None or n == 0 or i + 1 >= len(lines):
                 return None
-            vout = np.fromstring(lines[i + 1], dtype=np.float64, sep=" ")
-            if vout.size < n:
+            vout = _parse_row(lines[i + 1], n)
+            if vout is None:
                 return None
-            vout = vout[:n]
             i += 1
         i += 1
     if vin is None or vout is None:
         return None
     return vin, vout
+
+
+def _parse_row(line: str, n: int) -> np.ndarray | None:
+    """First ``n`` whitespace-separated doubles of the line (the
+    reference's GET_DOUBLE loop ignores trailing junk)."""
+    toks = line.split()[:n]
+    if len(toks) < n:
+        return None
+    try:
+        return np.array(toks, dtype=np.float64)
+    except ValueError:
+        return None
+
+
+def read_dir(directory: str):
+    """Read every sample in readdir order → (names, X, T) stacked arrays.
+
+    The batched drivers' bulk loader; skips unreadable/malformed files
+    the same way the per-sample driver does.
+    """
+    import sys
+
+    from hpnn_tpu.utils import logging as log
+
+    names, xs, ts = [], [], []
+    for name in list_sample_files(directory):
+        s = read_sample(os.path.join(directory, name))
+        if s is None:
+            continue
+        if xs and (s[0].shape != xs[0].shape or s[1].shape != ts[0].shape):
+            log.nn_warn(
+                sys.stderr,
+                "skipping %s: dims %ix%i != %ix%i\n",
+                name, s[0].size, s[1].size, xs[0].size, ts[0].size,
+            )
+            continue
+        names.append(name)
+        xs.append(s[0])
+        ts.append(s[1])
+    if not names:
+        return [], np.zeros((0, 0)), np.zeros((0, 0))
+    return names, np.stack(xs), np.stack(ts)
 
 
 def _count_after(line: str, tag: str) -> int | None:

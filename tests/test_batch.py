@@ -1,0 +1,107 @@
+"""Batched DP training/eval mode (train/batch.py).
+
+Acceptance bar is the mode's own (SURVEY.md §7.6): accuracy on a
+separable problem, plus exact agreement between the vectorized eval and
+the per-sample driver's argmax quirks.
+"""
+
+import numpy as np
+import pytest
+
+from hpnn_tpu.config import NNConf, NNTrain, NNType
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.train import batch as batch_mod, driver
+
+
+def _write_samples(d, n, n_in=8, n_out=2, snn=False, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = np.stack([np.r_[np.ones(n_in // 2), -np.ones(n_in // 2)],
+                        np.r_[-np.ones(n_in // 2), np.ones(n_in // 2)]])
+    for i in range(n):
+        c = i % 2
+        x = centers[c] + 0.1 * rng.normal(size=n_in)
+        lo = 0.0 if snn else -1.0
+        t = np.full(n_out, lo)
+        t[c] = 1.0
+        with open(d / f"s{i:05d}.txt", "w") as fp:
+            fp.write(f"[input] {n_in}\n" + " ".join(f"{v:.5f}" for v in x) + "\n")
+            fp.write(f"[output] {n_out}\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+def _conf(tmp_path, *, snn=False, train=NNTrain.BP, n=24):
+    sdir = tmp_path / "samples"
+    sdir.mkdir()
+    _write_samples(sdir, n, snn=snn)
+    k, _ = kernel_mod.generate(777, 8, [6], 2)
+    return NNConf(
+        name="t",
+        type=NNType.SNN if snn else NNType.ANN,
+        seed=1,
+        kernel=k,
+        train=train,
+        samples=str(sdir),
+        tests=str(sdir),
+    )
+
+
+@pytest.mark.parametrize("snn,train", [
+    (False, NNTrain.BP), (False, NNTrain.BPM), (True, NNTrain.BP),
+])
+def test_batched_training_learns(tmp_path, snn, train):
+    conf = _conf(tmp_path, snn=snn, train=train)
+    w0 = [np.asarray(w).copy() for w in conf.kernel.weights]
+    assert batch_mod.train_kernel_batched(conf, batch_size=8, epochs=60)
+    assert any(
+        not np.allclose(np.asarray(a), b)
+        for a, b in zip(conf.kernel.weights, w0)
+    )
+    # learned: batched eval counts all samples correct
+    names, X, T = __import__("hpnn_tpu.fileio.samples", fromlist=["read_dir"]).read_dir(conf.samples)
+    import jax.numpy as jnp
+
+    ev = batch_mod.make_eval_fn(model="snn" if snn else "ann")
+    weights = tuple(jnp.asarray(np.asarray(w)) for w in conf.kernel.weights)
+    out = np.asarray(ev(weights, jnp.asarray(X)))
+    ok = batch_mod.accuracy_counts(out, T, "snn" if snn else "ann")
+    assert ok == len(names)
+
+
+def test_batched_eval_matches_per_sample(tmp_path, capsys):
+    """run_kernel_batched prints the same PASS/FAIL verdicts as the
+    per-sample driver (order differs: readdir vs seeded shuffle)."""
+    from hpnn_tpu.utils import logging as log
+
+    log.set_verbose(2)
+    conf = _conf(tmp_path, n=12)
+    driver.run_kernel(conf)
+    per_sample = capsys.readouterr().out
+    (tmp_path / "b").mkdir()
+    conf2 = _conf(tmp_path / "b", n=12)
+    conf2.kernel = conf.kernel
+    batch_mod.run_kernel_batched(conf2)
+    batched = capsys.readouterr().out
+
+    def verdicts(text):
+        out = {}
+        for line in text.splitlines():
+            if "TESTING FILE:" in line:
+                name = line.split("TESTING FILE:")[1].split()[0]
+                out[name] = "[PASS]" in line
+        return out
+
+    a, b = verdicts(per_sample), verdicts(batched)
+    assert a and set(a) == set(b)
+    assert a == b
+
+
+def test_accuracy_counts_quirks():
+    """C quirks: all-below-threshold ANN target -> class index 1;
+    SNN all-nonpositive output -> guess 0."""
+    out = np.array([[0.9, 0.1], [0.1, 0.9]])
+    T = np.array([[-1.0, -1.0], [-1.0, 1.0]])  # row0: no target above 0.5
+    # row0: is_ok=1 (quirk), guess=0 -> wrong; row1: is_ok=1, guess=1 -> ok
+    assert batch_mod.accuracy_counts(out, T, "ann") == 1
+    out2 = np.array([[-0.5, -0.2]])
+    T2 = np.array([[1.0, 0.0]])
+    # SNN: no positive output -> guess stays 0 == is_ok 0
+    assert batch_mod.accuracy_counts(out2, T2, "snn") == 1
