@@ -23,6 +23,10 @@ def main(argv: list[str] | None = None) -> int:
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
         return -1
+    if "batch" not in opts and ("epochs" in opts or "mesh" in opts):
+        sys.stderr.write("syntax error: --epochs/--mesh require --batch!\n")
+        runtime.deinit_all()
+        return -1
     filename = common.parse_args(argv, "train_nn")
     if filename is None:
         runtime.deinit_all()
@@ -58,7 +62,7 @@ def main(argv: list[str] | None = None) -> int:
         with open("kernel.opt", "w") as fp:
             config.dump_kernel(conf, fp)
     except OSError:
-        sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
+        sys.stderr.write("FAILED to open kernel.opt for WRITE!\n")
         runtime.deinit_all()
         return -1
     runtime.deinit_all()

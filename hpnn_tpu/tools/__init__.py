@@ -1,0 +1,7 @@
+"""Data-preparation CLIs: ``pmnist``, ``pdif``, ``gen_ann``.
+
+TPU-side reimplementations of the reference's tutorial tooling
+(ref: /root/reference/tutorials/mnist/prepare_mnist.c,
+tutorials/ann/prepare_dif.c + file_dif.c, scripts/gen_ann.bash) with
+byte-compatible sample/kernel file output.
+"""

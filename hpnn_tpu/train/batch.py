@@ -123,7 +123,11 @@ def train_kernel_batched(
     dtype = _compute_dtype()
     model = _model_of(conf)
     momentum = conf.train == NNTrain.BPM
-    mesh = default_mesh(mesh_spec)
+    try:
+        mesh = default_mesh(mesh_spec)
+    except ValueError as exc:
+        log.nn_error(sys.stderr, "bad mesh: %s\n", exc)
+        return False
     n_data = mesh.shape[mesh_mod.DATA_AXIS]
     B = max(batch_size, n_data)
     B += (-B) % n_data  # divisible by the data axis
@@ -143,6 +147,10 @@ def train_kernel_batched(
 
     Xd = X.astype(dtype)
     Td = T.astype(dtype)
+    if conf.seed == 0:  # 0 means "random", like the reference's srandom
+        import time
+
+        conf.seed = int(time.time())
     rng = np.random.RandomState(conf.seed & 0x7FFFFFFF)
     loss = float("nan")
     for epoch in range(1, epochs + 1):
@@ -199,34 +207,9 @@ def run_kernel_batched(conf: NNConf) -> None:
     eval_fn = make_eval_fn(model=model)
     out = np.asarray(eval_fn(weights, jnp.asarray(X.astype(dtype))))
 
-    from hpnn_tpu.train.driver import _first_argmax, _first_argmax_pos, _last_above
+    from hpnn_tpu.train.driver import print_verdict
 
     for i, name in enumerate(names):
         log.nn_out(sys.stdout, "TESTING FILE: %16.16s\t", name)
-        o, t = out[i], T[i]
-        if model == "ann":
-            guess = _first_argmax(o)
-            is_ok = _last_above(t, 0.5, default=1)
-            if guess == is_ok:
-                log.nn_cout(sys.stdout, " [PASS]\n")
-            else:
-                log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
-        else:
-            log.nn_dbg(sys.stdout, " CLASS | PROBABILITY (%%)\n")
-            log.nn_dbg(sys.stdout, "-------|----------------\n")
-            for idx in range(o.shape[0]):
-                log.nn_dbg(sys.stdout, " %5i | %15.10f\n", idx + 1, o[idx] * 100.0)
-            log.nn_dbg(sys.stdout, "-------|----------------\n")
-            guess = _first_argmax_pos(o)
-            is_ok = _last_above(t, 0.1, default=0)
-            log.nn_cout(
-                sys.stdout,
-                " BEST CLASS idx=%i P=%15.10f",
-                guess + 1,
-                o[guess] * 100.0,
-            )
-            if guess == is_ok:
-                log.nn_cout(sys.stdout, " [PASS]\n")
-            else:
-                log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
+        print_verdict(out[i], T[i], model)
     log.flush()

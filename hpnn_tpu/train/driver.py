@@ -160,35 +160,42 @@ def run_kernel(conf: NNConf) -> None:
         out = np.asarray(
             loop.run_sample(weights, jnp.asarray(tr_in, dtype=dtype), model=model)
         )
-        if model == "ann":
-            # ref: src/libhpnn.c:1443-1457 — target threshold 0.5,
-            # LAST index above threshold wins
-            guess = _first_argmax(out)
-            # C quirk: is_ok starts at TRUE==1, so an all-negative
-            # target leaves class index 1 (ref: src/libhpnn.c:1443)
-            is_ok = _last_above(tr_out, 0.5, default=1)
-            if guess == is_ok:
-                log.nn_cout(sys.stdout, " [PASS]\n")
-            else:
-                log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
-        else:
-            # ref: src/libhpnn.c:1489-1514 — threshold 0.1, plus the
-            # BEST CLASS token and -vvv probability table
-            log.nn_dbg(sys.stdout, " CLASS | PROBABILITY (%%)\n")
-            log.nn_dbg(sys.stdout, "-------|----------------\n")
-            for idx in range(out.shape[0]):
-                log.nn_dbg(sys.stdout, " %5i | %15.10f\n", idx + 1, out[idx] * 100.0)
-            log.nn_dbg(sys.stdout, "-------|----------------\n")
-            guess = _first_argmax_pos(out)
-            is_ok = _last_above(tr_out, 0.1, default=0)
-            log.nn_cout(
-                sys.stdout, " BEST CLASS idx=%i P=%15.10f", guess + 1, out[guess] * 100.0
-            )
-            if guess == is_ok:
-                log.nn_cout(sys.stdout, " [PASS]\n")
-            else:
-                log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
+        print_verdict(out, tr_out, model)
         log.flush()
+
+
+def print_verdict(out: np.ndarray, target: np.ndarray, model: str) -> None:
+    """The eval token protocol for one sample — PASS/FAIL (+ SNN BEST
+    CLASS and -vvv probability table), shared by the per-sample and
+    batched eval paths (ref: src/libhpnn.c:1443-1514)."""
+    if model == "ann":
+        # ref: src/libhpnn.c:1443-1457 — target threshold 0.5,
+        # LAST index above threshold wins
+        guess = _first_argmax(out)
+        # C quirk: is_ok starts at TRUE==1, so an all-negative
+        # target leaves class index 1 (ref: src/libhpnn.c:1443)
+        is_ok = _last_above(target, 0.5, default=1)
+        if guess == is_ok:
+            log.nn_cout(sys.stdout, " [PASS]\n")
+        else:
+            log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
+    else:
+        # ref: src/libhpnn.c:1489-1514 — threshold 0.1, plus the
+        # BEST CLASS token and -vvv probability table
+        log.nn_dbg(sys.stdout, " CLASS | PROBABILITY (%%)\n")
+        log.nn_dbg(sys.stdout, "-------|----------------\n")
+        for idx in range(out.shape[0]):
+            log.nn_dbg(sys.stdout, " %5i | %15.10f\n", idx + 1, out[idx] * 100.0)
+        log.nn_dbg(sys.stdout, "-------|----------------\n")
+        guess = _first_argmax_pos(out)
+        is_ok = _last_above(target, 0.1, default=0)
+        log.nn_cout(
+            sys.stdout, " BEST CLASS idx=%i P=%15.10f", guess + 1, out[guess] * 100.0
+        )
+        if guess == is_ok:
+            log.nn_cout(sys.stdout, " [PASS]\n")
+        else:
+            log.nn_cout(sys.stdout, " [FAIL idx=%i]\n", is_ok + 1)
 
 
 def _first_argmax(out: np.ndarray) -> int:

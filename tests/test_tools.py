@@ -1,0 +1,147 @@
+"""pmnist / pdif / gen_ann converter tests (byte-level format checks)."""
+
+import struct
+import subprocess
+import sys
+
+import numpy as np
+
+from hpnn_tpu.fileio import samples as sample_io
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.tools import gen_ann, pdif, pmnist
+
+
+def _write_idx(tmp, images, labels):
+    n, rows, cols = images.shape
+    with open(tmp / "train_images", "wb") as fp:
+        fp.write(struct.pack(">IIII", 0x803, n, rows, cols))
+        fp.write(images.astype(np.uint8).tobytes())
+    with open(tmp / "train_labels", "wb") as fp:
+        fp.write(struct.pack(">II", 0x801, n))
+        fp.write(labels.astype(np.uint8).tobytes())
+
+
+def test_pmnist_format(tmp_path, monkeypatch, capsys):
+    rng = np.random.RandomState(3)
+    images = rng.randint(0, 256, (4, 28, 28))
+    labels = np.array([3, 0, 9, 7])
+    _write_idx(tmp_path, images, labels)
+    # test set = same files under the test names
+    (tmp_path / "test_images").write_bytes((tmp_path / "train_images").read_bytes())
+    (tmp_path / "test_labels").write_bytes((tmp_path / "train_labels").read_bytes())
+    (tmp_path / "samples").mkdir()
+    (tmp_path / "tests").mkdir()
+    monkeypatch.chdir(tmp_path)
+    assert pmnist.main(["samples", "tests"]) == 0
+
+    # byte-level format of the first sample
+    text = (tmp_path / "samples" / "s00001.txt").read_text().splitlines()
+    assert text[0] == "[input] 784"
+    assert text[1].startswith("%7.5f" % float(images[0].ravel()[0]))
+    assert text[2] == "[output] 10  #3"
+    assert text[3].split() == [
+        "1.0" if i == 3 else "-1.0" for i in range(10)
+    ]
+    # readable by the framework reader, pixels unnormalized
+    x, t = sample_io.read_sample(str(tmp_path / "samples" / "s00001.txt"))
+    assert x.shape == (784,) and t.shape == (10,)
+    np.testing.assert_allclose(x, images[0].ravel().astype(float), atol=1e-5)
+    # index continues into the test set (reference quirk kept)
+    names = sorted(p.name for p in (tmp_path / "tests").iterdir())
+    assert names[0] == "s00005.txt"
+    # conscious fix: test labels NOT shifted (label[i] with image[i])
+    _, t = sample_io.read_sample(str(tmp_path / "tests" / "s00005.txt"))
+    assert np.argmax(t) == 3
+
+
+DIF_TEXT = """Quartz
+   Sample: T = 25 C
+
+      CELL PARAMETERS:   4.913   4.913   5.405   90.0   90.0  120.0
+      SPACE GROUP: P3_221
+
+           ATOM        X         Y         Z     OCCUPANCY  ISO(B)
+            Si     0.46970   0.00000   0.00000     1.000     1.000
+            O      0.41350   0.26690   0.11910     1.000     1.000
+
+            X-RAY WAVELENGTH:     1.541838
+
+               2-THETA      INTENSITY
+                20.86        21.84
+                26.64       100.00
+"""
+
+RAW_TEXT = """##direct scan
+10.0 5.0
+20.0 7.0
+40.0 11.0
+88.0 3.0
+"""
+
+
+def test_pdif_pipeline(tmp_path, capsys):
+    (tmp_path / "rruff" / "dif").mkdir(parents=True)
+    (tmp_path / "rruff" / "raw").mkdir()
+    (tmp_path / "rruff" / "dif" / "R000001").write_text(DIF_TEXT)
+    (tmp_path / "rruff" / "raw" / "R000001").write_text(RAW_TEXT)
+    (tmp_path / "samples").mkdir()
+    assert pdif.main(
+        [str(tmp_path / "rruff"), "-i", "4", "-o", "230",
+         "-s", str(tmp_path / "samples")]
+    ) == 0
+    out = (tmp_path / "samples" / "R000001").read_text().splitlines()
+    assert out[0] == "[input] 5"  # 4 bins + temperature
+    vals = out[1].split()
+    # temperature: (25+273.15)/273.15
+    assert vals[0] == "%7.5f" % (298.15 / 273.15)
+    # bins over [5,90): width 21.25 -> [5,26.25):5+7=12, [26.25,47.5):11,
+    # [47.5,68.75):0, [68.75,90):3; normalized by 12
+    np.testing.assert_allclose(
+        [float(v) for v in vals[1:]], [1.0, 11 / 12, 0.0, 3 / 12], atol=1e-5
+    )
+    assert out[2] == "[output] 230"
+    hot = out[3].split()
+    # P3_221 is space group 154 -> one-hot index 153
+    assert hot[153] == "1.0" and hot.count("1.0") == 1
+
+
+def test_pdif_skips_mo_radiation(tmp_path, capsys):
+    txt = DIF_TEXT.replace("1.541838", "0.710730")
+    (tmp_path / "rruff" / "dif").mkdir(parents=True)
+    (tmp_path / "rruff" / "raw").mkdir()
+    (tmp_path / "rruff" / "dif" / "R000002").write_text(txt)
+    (tmp_path / "rruff" / "raw" / "R000002").write_text(RAW_TEXT)
+    (tmp_path / "samples").mkdir()
+    assert pdif.main(
+        [str(tmp_path / "rruff"), "-i", "4", "-o", "230",
+         "-s", str(tmp_path / "samples")]
+    ) == 0
+    assert not (tmp_path / "samples" / "R000002").exists()
+
+
+def test_gen_ann_loadable(tmp_path, capsys):
+    assert gen_ann.main(["--seed", "42", "8", "6", "4"]) == 0
+    text = capsys.readouterr().out
+    kfile = tmp_path / "k.txt"
+    kfile.write_text(text)
+    name, k = kernel_mod.load(str(kfile))
+    assert name == "auto"
+    assert k.n_inputs == 8 and k.hidden_sizes == (6,) and k.n_outputs == 4
+    # weights within the quirky urandom range: 2*(v/100000-0.5)/sqrt(M)
+    w = np.concatenate([np.asarray(x).ravel() for x in k.weights])
+    assert w.min() >= -1.0 / np.sqrt(6) - 1e-9
+    assert w.max() <= 2 * (65535 / 100000 - 0.5) / np.sqrt(6) + 1e-9
+
+
+def test_gen_ann_cli_roundtrip(tmp_path):
+    """Console entry point output feeds train-able kernels."""
+    res = subprocess.run(
+        [sys.executable, "-m", "hpnn_tpu.tools.gen_ann",
+         "--seed", "7", "4", "3", "2"],
+        capture_output=True, text=True,
+    )
+    assert res.returncode == 0
+    kfile = tmp_path / "g.txt"
+    kfile.write_text(res.stdout)
+    _, k = kernel_mod.load(str(kfile))
+    assert k.n_inputs == 4

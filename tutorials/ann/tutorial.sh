@@ -1,0 +1,50 @@
+#!/bin/bash
+# RRUFF-XRD space-group tutorial — hpnn-tpu port
+# (ref: /root/reference/tutorials/ann/tutorial.bash).
+#
+# Expects the RRUFF XRD data unpacked under ./rruff/dif and ./rruff/raw
+# (the reference downloads difs+raw zips from rruff.info).  Converts
+# with pdif -i 850 -o 230, then trains an 851-230-230 ANN with BPM
+# (alpha=0.2, ref conf: tutorial.bash:9) for 1 + N_ROUNDS rounds; the
+# test set is a copy of the samples (ref: tutorial.bash:151-158).
+set -u
+N_ROUNDS=${N_ROUNDS:-10}
+for tool in pdif train_nn run_nn; do
+    command -v "$tool" >/dev/null || { echo "Can't find $tool!"; exit 1; }
+done
+[ -d ./rruff/dif ] && [ -d ./rruff/raw ] || {
+    echo "RRUFF data not found: need ./rruff/dif and ./rruff/raw"
+    echo "(download the XRD dif + raw archives from rruff.info)"
+    exit 1
+}
+rm -rf samples tests && mkdir -p samples tests
+pdif ./rruff -i 850 -o 230 -s ./samples || exit 1
+cp ./samples/* ./tests/
+
+cat > xrd.conf <<'EOF'
+[name] RRUFF_XRD
+[type] ANN
+[init] generate
+[seed] 0
+[input] 851
+[hidden] 230
+[output] 230
+[train] BPM
+[sample_dir] ./samples
+[test_dir] ./tests
+EOF
+sed -e 's/^\[init\].*/[init] kernel.opt/g' xrd.conf > cont_xrd.conf
+
+rm -f raw log results; touch raw log
+train_nn -v -v -v ./xrd.conf &> log
+run_nn -v -v ./cont_xrd.conf &> results
+N=$(grep -c 'TESTING' results || true)
+NRS=$(grep -c PASS results || true)
+echo "0 $NRS/$N" >> raw; tail -1 raw
+for IDX in $(seq 1 "$N_ROUNDS"); do
+    train_nn -v -v -v ./cont_xrd.conf &> log
+    run_nn -v -v ./cont_xrd.conf &> results
+    NRS=$(grep -c PASS results || true)
+    echo "$IDX $NRS/$N" >> raw; tail -1 raw
+done
+echo "All DONE!"

@@ -1,0 +1,36 @@
+#!/bin/bash
+# MNIST SNN variant — 30 rounds, softmax output + cross-entropy
+# (ref: /root/reference/tutorials/mnist/opt_mnist.bash).  Run from the
+# same directory as tutorial.sh AFTER its data preparation (./mnist).
+set -u
+N_ROUNDS=${N_ROUNDS:-30}
+cd mnist || { echo "run tutorial.sh first (needs ./mnist)"; exit 1; }
+
+cat > mnist_snn.conf <<'EOF'
+[name] MNIST
+[type] SNN
+[init] generate
+[seed] 10958
+[input] 784
+[hidden] 300
+[output] 10
+[train] BP
+[sample_dir] ./samples
+[test_dir] ./tests
+EOF
+sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' \
+    mnist_snn.conf > cont_mnist_snn.conf
+
+rm -f raw log results; touch raw log
+train_nn -v -v ./mnist_snn.conf &> log
+run_nn -v -v -v -v ./cont_mnist_snn.conf &> results
+NRS=$(grep -c PASS results || true); NOK=$(grep -c ' OK ' log || true)
+echo "1 $(awk -v n="$NRS" 'BEGIN{printf "%.1f",100*n/10000}') $(awk -v n="$NOK" 'BEGIN{printf "%.1f",100*n/60000}')" > raw
+for IDX in $(seq 2 "$N_ROUNDS"); do
+    train_nn -v -v ./cont_mnist_snn.conf &> log
+    run_nn -v -v -v -v ./cont_mnist_snn.conf &> results
+    NRS=$(grep -c PASS results || true); NOK=$(grep -c ' OK ' log || true)
+    echo "$IDX $(awk -v n="$NRS" 'BEGIN{printf "%.1f",100*n/10000}') $(awk -v n="$NOK" 'BEGIN{printf "%.1f",100*n/60000}')" >> raw
+    tail -1 raw
+done
+echo "All DONE!"

@@ -1,0 +1,88 @@
+#!/bin/bash
+# MNIST ANN tutorial — hpnn-tpu port of the reference tutorial
+# (ref: /root/reference/tutorials/mnist/tutorial.bash).
+#
+# Flow: (optionally) fetch MNIST -> pmnist conversion -> 784-300-10 ANN,
+# [train] BP, seed 10958 -> 1 + N_ROUNDS train/eval rounds, appending
+#   "<round> <PASS%> <OPT%>"
+# to ./mnist/raw (PASS = test top-1 over 10k, OPT = first-try-correct
+# over 60k).  NOTE: the reference's monitor swaps the denominators
+# (tutorial.bash:179-193 divides PASS by 60000 and OPT by 10000); this
+# port divides correctly, so compare raw counts against the reference,
+# not its percentages.
+#
+# Usage: tutorial.sh [--batch]   (--batch uses the TPU minibatch mode)
+set -u
+N_ROUNDS=${N_ROUNDS:-50}
+BATCH_MODE=
+[ "${1:-}" = "--batch" ] && BATCH_MODE=y
+
+for tool in pmnist train_nn run_nn; do
+    command -v "$tool" >/dev/null || { echo "Can't find $tool!"; exit 1; }
+done
+
+if [ ! -d ./mnist ]; then
+    echo "The MNIST database is required in ./mnist (train_images,"
+    echo "train_labels, test_images, test_labels — the renamed idx files)."
+    read -r -n 1 -p "Download MNIST database? Y/N " answer; echo
+    case $answer in
+    [Yy]*)
+        mkdir -p mnist/temp && cd mnist/temp || exit 1
+        for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+                 t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+            wget "https://ossci-datasets.s3.amazonaws.com/mnist/$f.gz" || exit 1
+            gunzip "$f.gz"
+        done
+        mv train-labels-idx1-ubyte ../train_labels
+        mv train-images-idx3-ubyte ../train_images
+        mv t10k-labels-idx1-ubyte ../test_labels
+        mv t10k-images-idx3-ubyte ../test_images
+        cd ../.. || exit 1
+        ;;
+    *) echo "mnist directory is not present!"; exit 1;;
+    esac
+fi
+
+cd mnist || exit 1
+echo "preparing samples"
+rm -rf samples tests && mkdir -p samples tests
+pmnist samples tests || exit 1
+
+echo "preparing configuration files"
+cat > mnist_ann.conf <<'EOF'
+[name] MNIST
+[type] ANN
+[init] generate
+[seed] 10958
+[input] 784
+[hidden] 300
+[output] 10
+[train] BP
+[sample_dir] ./samples
+[test_dir] ./tests
+EOF
+sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' \
+    mnist_ann.conf > cont_mnist_ann.conf
+
+BATCH_ARGS=
+[ -n "$BATCH_MODE" ] && BATCH_ARGS="--batch 256 --epochs 5"
+
+rm -f raw log results; touch raw log
+round_eval() {
+    NRS=$(grep -c PASS results || true)
+    NOK=$(grep -c ' OK ' log || true)
+    XRS=$(awk -v n="$NRS" 'BEGIN{printf "%.1f", 100*n/10000}')
+    XOK=$(awk -v n="$NOK" 'BEGIN{printf "%.1f", 100*n/60000}')
+    echo "$1 $XRS $XOK" >> raw
+    tail -1 raw
+}
+# first pass (generate + train + eval)
+train_nn -v -v -v $BATCH_ARGS ./mnist_ann.conf &> log
+run_nn -v -v ./cont_mnist_ann.conf &> results
+round_eval 0
+for IDX in $(seq 1 "$N_ROUNDS"); do
+    train_nn -v -v -v $BATCH_ARGS ./cont_mnist_ann.conf &> log
+    run_nn -v -v ./cont_mnist_ann.conf &> results
+    round_eval "$IDX"
+done
+echo "All DONE!"
