@@ -173,9 +173,13 @@ def dump_kernel(name: str, weights: list[np.ndarray], fp) -> None:
 
 
 def _write_rows(fp, w: np.ndarray, n: int, m: int) -> None:
+    from hpnn_tpu import native
+
     for j in range(n):
         fp.write(f"[neuron {j + 1}] {m}\n")
         row = w[j]
         # %17.15f per weight, space separated (ref: src/ann.c:820-824)
-        fp.write(" ".join("%17.15f" % v for v in row))
-        fp.write("\n")
+        text = native.format_row(row)
+        if text is None:
+            text = " ".join("%17.15f" % v for v in row) + "\n"
+        fp.write(text)

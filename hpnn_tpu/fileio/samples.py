@@ -56,6 +56,11 @@ def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
 def _parse_row(line: str, n: int) -> np.ndarray | None:
     """First ``n`` whitespace-separated doubles of the line (the
     reference's GET_DOUBLE loop ignores trailing junk)."""
+    from hpnn_tpu import native
+
+    row = native.parse_doubles(line, n)
+    if row is not None:
+        return row if row.size == n else None
     toks = line.split()[:n]
     if len(toks) < n:
         return None

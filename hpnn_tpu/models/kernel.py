@@ -71,18 +71,24 @@ def generate(
     """
     import time
 
+    from hpnn_tpu import native
+
     if seed == 0:
         seed = int(time.time())
-    rng = GlibcRandom(seed)
     sizes = list(hiddens) + [n_outputs]
     inputs = [n_inputs] + list(hiddens)
-    weights = []
-    for n, m in zip(sizes, inputs):
-        scale = 1.0 / np.sqrt(float(m))
-        vals = np.empty(n * m, dtype=np.float64)
-        for j in range(n * m):
-            vals[j] = 2.0 * (rng.random() / RAND_MAX - 0.5) * scale
-        weights.append(vals.reshape(n, m).astype(dtype))
+    shapes = list(zip(sizes, inputs))
+    arrs = native.glibc_weight_stream(seed, shapes)
+    if arrs is None:
+        rng = GlibcRandom(seed)
+        arrs = []
+        for n, m in shapes:
+            scale = 1.0 / np.sqrt(float(m))
+            vals = np.empty(n * m, dtype=np.float64)
+            for j in range(n * m):
+                vals[j] = 2.0 * (rng.random() / RAND_MAX - 0.5) * scale
+            arrs.append(vals.reshape(n, m))
+    weights = [a.astype(dtype) for a in arrs]
     return Kernel(tuple(weights)), seed
 
 

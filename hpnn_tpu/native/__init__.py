@@ -1,0 +1,170 @@
+"""Native (C++) runtime components, built on demand and bound via ctypes.
+
+``lib()`` returns the loaded library or ``None`` — every caller keeps a
+pure-Python fallback, so a missing toolchain degrades gracefully.  The
+shared object is cached next to the source and rebuilt when the source
+is newer.  Set ``HPNN_NO_NATIVE=1`` to force the Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "hpnn_native.cpp")
+_SO = os.path.join(_HERE, "libhpnn_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    # compile to a per-process temp file, then rename atomically so
+    # concurrent first-use builds can't interleave writes into the .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if res.returncode != 0:
+        sys.stderr.write(f"hpnn native build failed:\n{res.stderr}\n")
+        return False
+    try:
+        os.replace(tmp, _SO)
+    except OSError:
+        return False
+    return True
+
+
+def _bind(libc: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    libc.glibc_new.argtypes = [ctypes.c_uint32]
+    libc.glibc_new.restype = ctypes.c_void_p
+    libc.glibc_delete.argtypes = [ctypes.c_void_p]
+    libc.glibc_next.argtypes = [ctypes.c_void_p]
+    libc.glibc_next.restype = ctypes.c_int32
+    libc.glibc_fill.argtypes = [ctypes.c_void_p, ctypes.c_int64, i32p]
+    libc.glibc_weights.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_double, f64p,
+    ]
+    libc.glibc_shuffle.argtypes = [ctypes.c_uint32, ctypes.c_int64, i32p]
+    libc.parse_doubles.argtypes = [ctypes.c_char_p, ctypes.c_int64, f64p]
+    libc.parse_doubles.restype = ctypes.c_int64
+    libc.format_row.argtypes = [f64p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+    libc.format_row.restype = ctypes.c_int64
+    return libc
+
+
+def lib() -> ctypes.CDLL | None:
+    """The native library, building it on first use; None on failure.
+
+    ``HPNN_NO_NATIVE`` is honored on every call, even after a load."""
+    global _lib, _tried
+    if os.environ.get("HPNN_NO_NATIVE"):
+        return None
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = (not os.path.exists(_SO)) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build():
+                return None
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError as exc:
+            sys.stderr.write(f"hpnn native load failed: {exc}\n")
+            _lib = None
+    return _lib
+
+
+# ------------------------------------------------------- typed wrappers
+def glibc_shuffle(seed: int, n: int):
+    """File-visit order as int32 array, or None if native unavailable."""
+    import numpy as np
+
+    L = lib()
+    if L is None or n == 0:
+        return None
+    out = np.empty(n, dtype=np.int32)
+    L.glibc_shuffle(
+        ctypes.c_uint32(seed & 0xFFFFFFFF),
+        n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out
+
+
+def glibc_weight_stream(seed: int, layer_shapes):
+    """Per-layer weight arrays from one continuous glibc stream
+    (matches models.kernel.generate draw order), or None."""
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    h = L.glibc_new(ctypes.c_uint32(seed & 0xFFFFFFFF))
+    try:
+        outs = []
+        for n, m in layer_shapes:
+            arr = np.empty(n * m, dtype=np.float64)
+            L.glibc_weights(
+                h,
+                n * m,
+                1.0 / np.sqrt(float(m)),
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            )
+            outs.append(arr.reshape(n, m))
+        return outs
+    finally:
+        L.glibc_delete(h)
+
+
+def parse_doubles(text: str | bytes, maxn: int):
+    """First maxn doubles of a text line, or None if native unavailable."""
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    if isinstance(text, str):
+        text = text.encode()
+    # maxn may come from an untrusted file header; the line can hold at
+    # most (len+1)/2 numbers (1 char + separator each), so bound the
+    # allocation by the text itself
+    maxn = min(maxn, len(text) // 2 + 1)
+    out = np.empty(maxn, dtype=np.float64)
+    got = L.parse_doubles(
+        text, maxn, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    )
+    return out[:got]
+
+
+def format_row(row) -> str | None:
+    """A kernel dump row '%17.15f ...\\n', or None if native unavailable."""
+    import numpy as np
+
+    L = lib()
+    if L is None:
+        return None
+    row = np.ascontiguousarray(row, dtype=np.float64)
+    cap = 32 * row.size + 2
+    buf = ctypes.create_string_buffer(cap)
+    got = L.format_row(
+        row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), row.size, buf, cap
+    )
+    if got < 0:
+        return None
+    return buf.raw[:got].decode()

@@ -1,0 +1,145 @@
+// hpnn-tpu native runtime library.
+//
+// The reference is a pure-C library end to end; this module keeps the
+// framework's host-side runtime native where it is hot:
+//
+//  * glibc TYPE_3 random() clone — seed-for-seed parity of weight
+//    init (ref: /root/reference/src/ann.c:653-677) and of the
+//    sample-shuffle draw (ref: src/libhpnn.c:1218-1229), at C speed
+//    (the MNIST shuffle draws ~60k slots with rejection; the Python
+//    fallback spends seconds here per round).
+//  * text number parsing / formatting — the sample and kernel file
+//    formats are whitespace text (%7.5f / %17.15f); bulk-loading 60k
+//    MNIST samples or dumping a 238k-weight kernel is strtod/snprintf
+//    bound.
+//
+// Built on demand by hpnn_tpu/native/__init__.py (g++ -O2 -shared),
+// bound via ctypes; every entry point has a pure-Python fallback and
+// an equality test in tests/test_native.py.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr int kDeg = 31;
+constexpr int kSep = 3;
+constexpr double kRandMax = 2147483647.0;
+
+struct GlibcRng {
+  int32_t r[kDeg];
+  int f;
+  int p;
+};
+
+void rng_seed(GlibcRng* g, uint32_t seed) {
+  int32_t s = (int32_t)seed;
+  if (s == 0) s = 1;
+  g->r[0] = s;
+  for (int i = 1; i < kDeg; ++i) {
+    // glibc: s = 16807*s % 2147483647 via Schrage on int32
+    int32_t hi = s / 127773;
+    int32_t lo = s % 127773;
+    s = 16807 * lo - 2836 * hi;
+    if (s < 0) s += 2147483647;
+    g->r[i] = s;
+  }
+  g->f = kSep;
+  g->p = 0;
+  for (int i = 0; i < 10 * kDeg; ++i) {
+    uint32_t v = (uint32_t)g->r[g->f] + (uint32_t)g->r[g->p];
+    g->r[g->f] = (int32_t)v;
+    if (++g->f >= kDeg) g->f = 0;
+    if (++g->p >= kDeg) g->p = 0;
+  }
+}
+
+int32_t rng_next(GlibcRng* g) {
+  uint32_t v = (uint32_t)g->r[g->f] + (uint32_t)g->r[g->p];
+  g->r[g->f] = (int32_t)v;
+  if (++g->f >= kDeg) g->f = 0;
+  if (++g->p >= kDeg) g->p = 0;
+  return (int32_t)(v >> 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* glibc_new(uint32_t seed) {
+  GlibcRng* g = new GlibcRng;
+  rng_seed(g, seed);
+  return g;
+}
+
+void glibc_delete(void* h) { delete (GlibcRng*)h; }
+
+int32_t glibc_next(void* h) { return rng_next((GlibcRng*)h); }
+
+// n raw draws into out
+void glibc_fill(void* h, int64_t n, int32_t* out) {
+  GlibcRng* g = (GlibcRng*)h;
+  for (int64_t i = 0; i < n; ++i) out[i] = rng_next(g);
+}
+
+// n weights 2*(random()/RAND_MAX - 0.5)*scale (ref: src/ann.c:700-706)
+void glibc_weights(void* h, int64_t n, double scale, double* out) {
+  GlibcRng* g = (GlibcRng*)h;
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = 2.0 * ((double)rng_next(g) / kRandMax - 0.5) * scale;
+}
+
+// The training/eval file-visit order: draw slots in [0,n) with
+// rejection of already-drawn slots (ref: src/libhpnn.c:1218-1229).
+void glibc_shuffle(uint32_t seed, int64_t n, int32_t* out) {
+  GlibcRng rng;
+  rng_seed(&rng, seed);
+  bool* taken = (bool*)calloc((size_t)n, 1);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t idx;
+    do {
+      idx = (int64_t)((double)rng_next(&rng) * (double)n / kRandMax);
+      if (idx >= n) idx = n - 1;  // 2^-31 edge the C code would overrun
+    } while (taken[idx]);
+    taken[idx] = true;
+    out[i] = (int32_t)idx;
+  }
+  free(taken);
+}
+
+// Parse up to maxn whitespace-separated doubles from buf (strtod
+// semantics, like the reference's GET_DOUBLE loops). Returns count.
+int64_t parse_doubles(const char* buf, int64_t maxn, double* out) {
+  const char* p = buf;
+  char* end;
+  int64_t count = 0;
+  while (count < maxn) {
+    double v = strtod(p, &end);
+    if (end == p) break;
+    out[count++] = v;
+    p = end;
+  }
+  return count;
+}
+
+// Format m doubles as the kernel row "%17.15f %17.15f ...\n"
+// (ref dump format: src/ann.c:770-857). Returns bytes written
+// (excluding NUL), or -1 if cap is too small.
+int64_t format_row(const double* w, int64_t m, char* out, int64_t cap) {
+  int64_t pos = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    if (cap - pos < 32) return -1;
+    int k = snprintf(out + pos, (size_t)(cap - pos), i ? " %17.15f" : "%17.15f",
+                     w[i]);
+    if (k < 0) return -1;
+    pos += k;
+  }
+  if (cap - pos < 2) return -1;
+  out[pos++] = '\n';
+  out[pos] = '\0';
+  return pos;
+}
+
+}  // extern "C"

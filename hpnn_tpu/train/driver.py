@@ -35,7 +35,6 @@ from hpnn_tpu.fileio import samples as sample_io
 from hpnn_tpu.models import kernel as kernel_mod
 from hpnn_tpu.train import loop
 from hpnn_tpu.utils import logging as log
-from hpnn_tpu.utils.glibc_random import GlibcRandom
 
 
 def _compute_dtype():
@@ -52,15 +51,10 @@ def _compute_dtype():
 
 def _shuffled_files(directory: str, seed: int):
     """Yield file names in the reference's seeded random draw order."""
+    from hpnn_tpu.utils.glibc_random import shuffled_order
+
     flist = sample_io.list_sample_files(directory)
-    n = len(flist)
-    rng = GlibcRandom(seed)
-    taken = [False] * n
-    for _ in range(n):
-        idx = rng.draw_index(n)
-        while taken[idx]:
-            idx = rng.draw_index(n)
-        taken[idx] = True
+    for idx in shuffled_order(seed, len(flist)):
         yield flist[idx]
 
 

@@ -1,0 +1,102 @@
+"""Native (C++) runtime vs pure-Python equality.
+
+The native library accelerates the glibc PRNG, the shuffle, text
+parsing, and kernel-row formatting; each entry point must agree exactly
+with the Python fallback (which itself is validated against real glibc
+in tests/test_glibc_random.py).
+"""
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import native
+from hpnn_tpu.utils.glibc_random import RAND_MAX, GlibcRandom
+
+pytestmark = pytest.mark.skipif(
+    native.lib() is None, reason="native toolchain unavailable"
+)
+
+
+def test_prng_stream_matches_python():
+    import ctypes
+
+    L = native.lib()
+    py = GlibcRandom(10958)
+    h = L.glibc_new(10958)
+    try:
+        for _ in range(1000):
+            assert L.glibc_next(h) == py.random()
+    finally:
+        L.glibc_delete(h)
+
+
+def test_weight_stream_matches_python():
+    shapes = [(30, 7), (5, 30)]
+    got = native.glibc_weight_stream(1234, shapes)
+    rng = GlibcRandom(1234)
+    for n, m in shapes:
+        scale = 1.0 / np.sqrt(float(m))
+        want = np.array(
+            [2.0 * (rng.random() / RAND_MAX - 0.5) * scale for _ in range(n * m)]
+        ).reshape(n, m)
+        np.testing.assert_array_equal(got.pop(0), want)
+
+
+def test_shuffle_matches_python():
+    # compute the python answer directly with the raw rejection loop
+    rng = GlibcRandom(42)
+    n = 257
+    taken = [False] * n
+    want = []
+    for _ in range(n):
+        idx = rng.draw_index(n)
+        while taken[idx]:
+            idx = rng.draw_index(n)
+        taken[idx] = True
+        want.append(idx)
+    got = native.glibc_shuffle(42, n)
+    assert got is not None
+    assert list(got) == want
+    assert sorted(got) == list(range(n))
+
+
+def test_parse_doubles():
+    got = native.parse_doubles("  1.5 -2.25e1 0.125 junk 7", 10)
+    np.testing.assert_array_equal(got, [1.5, -22.5, 0.125])
+    got = native.parse_doubles("1 2 3 4", 2)
+    np.testing.assert_array_equal(got, [1.0, 2.0])
+
+
+def test_no_native_env_disables(monkeypatch):
+    monkeypatch.setenv("HPNN_NO_NATIVE", "1")
+    assert native.lib() is None
+    assert native.glibc_shuffle(1, 4) is None
+    assert native.parse_doubles("1 2", 2) is None
+
+
+def test_parse_doubles_bounded_by_text():
+    """A huge untrusted count must not drive a huge allocation."""
+    got = native.parse_doubles("1.0 2.0", 10**15)
+    np.testing.assert_array_equal(got, [1.0, 2.0])
+
+
+def test_format_row_matches_python():
+    rng = np.random.RandomState(0)
+    row = rng.uniform(-2, 2, 64)
+    want = " ".join("%17.15f" % v for v in row) + "\n"
+    assert native.format_row(row) == want
+
+
+def test_kernel_dump_golden_stability(tmp_path):
+    """Native-formatted dump reloads to identical weights."""
+    from hpnn_tpu.fileio import kernel_format
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    k, _ = kernel_mod.generate(7, 6, [5], 3)
+    p = tmp_path / "k.txt"
+    with open(p, "w") as fp:
+        kernel_format.dump_kernel("g", [np.asarray(w) for w in k.weights], fp)
+    name, ws = kernel_format.load_kernel(str(p))
+    assert name == "g"
+    for a, b in zip(ws, k.weights):
+        np.testing.assert_allclose(a, np.asarray(b), atol=1e-15)
