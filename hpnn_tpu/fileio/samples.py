@@ -16,8 +16,11 @@ that order, so readdir order is part of the reproducibility contract.
 from __future__ import annotations
 
 import os
+import re
 
 import numpy as np
+
+_FLOAT_PREFIX = re.compile(r"[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?")
 
 
 def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
@@ -61,13 +64,24 @@ def _parse_row(line: str, n: int) -> np.ndarray | None:
     row = native.parse_doubles(line, n)
     if row is not None:
         return row if row.size == n else None
-    toks = line.split()[:n]
-    if len(toks) < n:
+    # strtod-like fallback: parse tokens until one fails, salvaging a
+    # leading numeric prefix like strtod does ("2.5x" -> 2.5, stop).
+    # (C99 hex floats parse natively but not here; neither converter
+    # ever writes them.)
+    out: list[float] = []
+    for tok in line.split():
+        if len(out) >= n:
+            break
+        try:
+            out.append(float(tok))
+        except ValueError:
+            m = _FLOAT_PREFIX.match(tok)
+            if m:
+                out.append(float(m.group(0)))
+            break
+    if len(out) < n:
         return None
-    try:
-        return np.array(toks, dtype=np.float64)
-    except ValueError:
-        return None
+    return np.array(out, dtype=np.float64)
 
 
 def read_dir(directory: str):

@@ -30,16 +30,19 @@ def _build() -> bool:
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if res.returncode != 0:
+            sys.stderr.write(f"hpnn native build failed:\n{res.stderr}\n")
+            return False
+        os.replace(tmp, _SO)
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
-    if res.returncode != 0:
-        sys.stderr.write(f"hpnn native build failed:\n{res.stderr}\n")
-        return False
-    try:
-        os.replace(tmp, _SO)
-    except OSError:
-        return False
-    return True
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _bind(libc: ctypes.CDLL) -> ctypes.CDLL:

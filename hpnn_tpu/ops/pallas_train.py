@@ -1,0 +1,282 @@
+"""Fused per-sample convergence trainer as ONE Pallas TPU kernel.
+
+The reference's innermost hot loop launches ~(n_layers × streams × 3)
+CUDA kernels/gemvs per iteration from host code (SURVEY.md §3.1); the
+XLA path (train/loop.py) already collapses that to one on-device
+``lax.while_loop``, but each iteration still runs as a chain of small
+HLO ops with HBM round-trips between them.  This kernel goes one step
+further, the Pallas way:
+
+* the WHOLE do-while convergence loop (up to 102399 iterations,
+  ref: include/libhpnn.h:67-74) runs inside one kernel launch;
+* weights, activations, and deltas live in VMEM for the entire sample —
+  an MNIST 784-300-10 f32 kernel is ~0.95 MB, far under the ~16 MB/core
+  budget — so HBM traffic is one read + one write per SAMPLE instead of
+  per iteration;
+* updates are written in place via ``input_output_aliases``.
+
+Semantics are identical to train/loop.py (same quirks: max-iter break
+before the min-iter clamp, first_ok at it==1, ok & it>min_iter after
+the loop); tests/test_pallas.py proves equality iteration-for-iteration
+against the lax implementation in interpret mode.
+
+Supported: ANN and SNN, BP and BPM (momentum), any depth.  Opt in with
+``HPNN_PALLAS=1``.
+
+Measured reality check (v5e, MNIST 784-300-10, BASELINE.md): XLA's
+while_loop path reaches 22.0k iters/s where this kernel reaches 14.9k
+at faithful (HIGHEST) dot precision — at M=1 matvec shapes XLA's fused
+VPU reductions beat Mosaic's MXU lowering, and with default (bf16-
+input) dots the kernel is fast but its trajectories diverge from the
+f64 oracle (26.2k vs 41.9k total iterations on the probe workload).
+All dots therefore pin ``precision=HIGHEST``; the lax path stays the
+default dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hpnn_tpu.models import ann, snn
+from hpnn_tpu.train.loop import SampleResult
+
+_F32 = jnp.float32
+
+
+def _row_iota(n: int):
+    return lax.broadcasted_iota(jnp.int32, (1, n), 1)
+
+
+def _first_argmax_2d(v):
+    """First index of the row max of a (1, n) vector (== jnp.argmax,
+    including NaN semantics: the first NaN wins if any is present)."""
+    n = v.shape[1]
+    iota = _row_iota(n)
+    first_max = jnp.min(jnp.where(v == jnp.max(v), iota, n))
+    isnan = jnp.isnan(v)
+    first_nan = jnp.min(jnp.where(isnan, iota, n))
+    return jnp.where(jnp.any(isnan), first_nan, first_max)
+
+
+def _kernel(
+    x_ref,
+    t_ref,
+    alpha_ref,
+    delta_ref,
+    *refs,
+    n_layers: int,
+    model: str,
+    momentum: bool,
+    min_iter: int,
+    max_iter: int,
+    lr: float,
+):
+    # ref layout: [aliased input state refs (ignored — same memory as
+    # the output state refs), output state refs, 5 scalar outputs, out
+    # vector, then scratch: acts and deltas per layer]
+    n_state = n_layers * (2 if momentum else 1)
+    out_state = refs[n_state : 2 * n_state]
+    w = list(out_state[:n_layers])
+    dw = list(out_state[n_layers:]) if momentum else []
+    pos = 2 * n_state
+    ep0_ref, niter_ref, dep_ref, first_ref, final_ref, out_ref = refs[pos : pos + 6]
+    acts = list(refs[pos + 6 : pos + 6 + n_layers])
+    ds = list(refs[pos + 6 + n_layers : pos + 6 + 2 * n_layers])
+
+    x = x_ref[:]
+    t = t_ref[:]
+    n_out = t.shape[1]
+    alpha = alpha_ref[0]
+    delta = delta_ref[0]
+
+    def forward():
+        """acts[l] <- layer activations from current weights."""
+        v = x
+        for l in range(n_layers):
+            z = lax.dot_general(
+                v,
+                w[l][:],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=_F32,
+                precision=lax.Precision.HIGHEST,
+            )
+            if model == "snn" and l == n_layers - 1:
+                e = jnp.exp(z - 1.0)  # quirk: exp(z-1), no max-shift
+                v = e / (snn.TINY + jnp.sum(e))
+            else:
+                v = ann.act(z)
+            acts[l][:] = v
+
+    def err():
+        o = acts[-1][:]
+        if model == "snn":
+            return -jnp.sum(t * jnp.log(o + snn.TINY)) / n_out
+        d = t - o
+        return 0.5 * jnp.sum(d * d)
+
+    def backward_update():
+        """ds[*] from current weights/acts, then in-place updates."""
+        o = acts[-1][:]
+        if model == "snn":
+            ds[-1][:] = t - o
+        else:
+            ds[-1][:] = (t - o) * ann.dact(o)
+        for l in range(n_layers - 2, -1, -1):
+            part = lax.dot_general(
+                ds[l + 1][:],
+                w[l + 1][:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=_F32,
+                precision=lax.Precision.HIGHEST,
+            )
+            ds[l][:] = part * ann.dact(acts[l][:])
+        for l in range(n_layers):
+            v_prev = x if l == 0 else acts[l - 1][:]
+            outer = lax.dot_general(
+                ds[l][:],
+                v_prev,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=_F32,
+                precision=lax.Precision.HIGHEST,
+            )
+            if momentum:
+                m = dw[l][:] + lr * outer
+                w[l][:] = w[l][:] + m
+                dw[l][:] = alpha * m
+            else:
+                w[l][:] = w[l][:] + lr * outer
+
+    forward()
+    ep0 = err()
+    p_trg = jnp.max(jnp.where(t == 1.0, _row_iota(n_out), 0))
+
+    def body(carry):
+        it, _dep, _ok, first_ok = carry
+        it = it + 1
+        ep = err()
+        backward_update()
+        forward()
+        epr = err()
+        dep = ep - epr
+        ok = _first_argmax_2d(acts[-1][:]) == p_trg
+        first_ok = jnp.where(it == 1, ok, first_ok)
+        return (it, dep, ok, first_ok)
+
+    def cond(carry):
+        it, dep, ok, _first = carry
+        ok_eff = ok & (it > min_iter)
+        return (it == 0) | ((it <= max_iter) & ((dep > delta) | ~ok_eff))
+
+    init = (jnp.int32(0), jnp.float32(jnp.inf), jnp.bool_(False), jnp.bool_(False))
+    it, dep, ok, first_ok = lax.while_loop(cond, body, init)
+
+    ep0_ref[0] = ep0
+    niter_ref[0] = it
+    dep_ref[0] = dep
+    first_ref[0] = jnp.int32(first_ok)
+    final_ref[0] = jnp.int32(ok & (it > min_iter))
+    out_ref[:] = acts[-1][:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "momentum", "min_iter", "max_iter", "interpret"),
+)
+def train_sample_fused(
+    weights,
+    dw,
+    x,
+    target,
+    alpha,
+    delta,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int,
+    max_iter: int,
+    interpret: bool = False,
+):
+    """Drop-in fused equivalent of ``loop.train_sample`` (f32)."""
+    n_layers = len(weights)
+    lr = snn.SNN_LEARN_RATE if model == "snn" else (
+        ann.BPM_LEARN_RATE if momentum else ann.BP_LEARN_RATE
+    )
+    weights = tuple(jnp.asarray(wl, dtype=_F32) for wl in weights)
+    dw = tuple(jnp.asarray(m, dtype=_F32) for m in dw) if momentum else ()
+    x2 = jnp.asarray(x, dtype=_F32).reshape(1, -1)
+    t2 = jnp.asarray(target, dtype=_F32).reshape(1, -1)
+    n_out = t2.shape[1]
+
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem1 = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    out_shape = (
+        tuple(jax.ShapeDtypeStruct(wl.shape, _F32) for wl in weights)
+        + (tuple(jax.ShapeDtypeStruct(m.shape, _F32) for m in dw) if momentum else ())
+        + (
+            jax.ShapeDtypeStruct((1,), _F32),   # ep0
+            jax.ShapeDtypeStruct((1,), jnp.int32),  # n_iter
+            jax.ShapeDtypeStruct((1,), _F32),   # dep
+            jax.ShapeDtypeStruct((1,), jnp.int32),  # first_ok
+            jax.ShapeDtypeStruct((1,), jnp.int32),  # final_ok
+            jax.ShapeDtypeStruct((1, n_out), _F32),  # out vector
+        )
+    )
+    n_state = n_layers * (2 if momentum else 1)
+    out_specs = (
+        tuple(vmem for _ in range(n_state))
+        + (smem1, smem1, smem1, smem1, smem1, vmem)
+    )
+    # inputs: x, t, alpha, delta, then the aliased state arrays
+    in_specs = [vmem, vmem, smem1, smem1] + [vmem] * n_state
+    # alias weight (+dw) inputs onto the leading outputs: in-place update
+    aliases = {4 + i: i for i in range(n_state)}
+
+    scratch = [
+        pltpu.VMEM((1, wl.shape[0]), _F32) for wl in weights
+    ] + [pltpu.VMEM((1, wl.shape[0]), _F32) for wl in weights]
+
+    kernel = functools.partial(
+        _kernel,
+        n_layers=n_layers,
+        model=model,
+        momentum=momentum,
+        min_iter=min_iter,
+        max_iter=max_iter,
+        lr=lr,
+    )
+    results = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(
+        x2,
+        t2,
+        jnp.asarray(alpha, dtype=_F32).reshape(1),
+        jnp.asarray(delta, dtype=_F32).reshape(1),
+        *weights,
+        *dw,
+    )
+    new_w = tuple(results[:n_layers])
+    new_dw = tuple(results[n_layers : n_layers * 2]) if momentum else ()
+    ep0, n_iter, dep, first_ok, final_ok, out = results[n_state:]
+    return SampleResult(
+        new_w,
+        new_dw,
+        ep0[0],
+        n_iter[0],
+        dep[0],
+        first_ok[0].astype(bool),
+        final_ok[0].astype(bool),
+        out[0],
+    )

@@ -80,9 +80,13 @@ def convergence_loop(
 
     ``one_iteration(w, m, acts) -> (w, m, acts, dEp)``;
     ``out_argmax(out) -> index`` (masked for padded TP kernels).
-    All C-parity quirks live here and only here: the it==0 bootstrap,
-    the max-iter break before the min-iter clamp, first_ok captured at
-    it==1, and final_ok = ok & (it > min_iter) applied after the loop.
+    C-parity quirks live here: the it==0 bootstrap, the max-iter break
+    before the min-iter clamp, first_ok captured at it==1, and
+    final_ok = ok & (it > min_iter) applied after the loop.  NOTE: the
+    fused Pallas kernel (ops/pallas_train.py::_kernel) mirrors this
+    skeleton with ref mutation instead of a carry — any quirk change
+    here must be applied there too (tests/test_pallas.py pins them
+    equal).
     """
 
     def body(state):
@@ -112,10 +116,65 @@ def convergence_loop(
     return SampleResult(w, m, ep0, it, dep, first_ok, final_ok, acts[-1])
 
 
+def _pallas_eligible(weights) -> bool:
+    """Fused Pallas path: opt-in (HPNN_PALLAS=1), TPU platform, f32.
+
+    Measured on v5e (BASELINE.md): for the MLP matvec shapes XLA's
+    fused while_loop is faster than the fused Mosaic kernel (22.0k vs
+    14.9k faithful-precision iters/s on MNIST 784-300-10), so the lax
+    path stays the default; the kernel remains available for
+    experimentation and as the base for batched variants.
+    """
+    import os
+
+    if os.environ.get("HPNN_PALLAS", "0") != "1":
+        return False
+    try:
+        if jax.devices()[0].platform != "tpu":
+            return False
+    except RuntimeError:
+        return False
+    return all(jnp.asarray(w).dtype == jnp.float32 for w in weights)
+
+
+def train_sample(
+    weights,
+    dw,
+    x,
+    target,
+    alpha,
+    delta,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int = MIN_BP_ITER,
+    max_iter: int = MAX_BP_ITER,
+):
+    """Train one sample to convergence.
+
+    Dispatches to the fused single-kernel Pallas trainer on TPU
+    (ops/pallas_train.py — whole convergence loop in VMEM) and to the
+    jitted lax while_loop otherwise (CPU, f64 parity mode).
+    """
+    if _pallas_eligible(weights):
+        from hpnn_tpu.ops import pallas_train
+
+        return pallas_train.train_sample_fused(
+            weights, dw, x, target, alpha, delta,
+            model=model, momentum=momentum,
+            min_iter=min_iter, max_iter=max_iter,
+        )
+    return train_sample_lax(
+        weights, dw, x, target, alpha, delta,
+        model=model, momentum=momentum,
+        min_iter=min_iter, max_iter=max_iter,
+    )
+
+
 @functools.partial(
     jax.jit, static_argnames=("model", "momentum", "min_iter", "max_iter")
 )
-def train_sample(
+def train_sample_lax(
     weights,
     dw,
     x,

@@ -1,0 +1,67 @@
+"""Fused Pallas trainer vs the lax while_loop trainer (interpret mode).
+
+Both run in f32 so trajectories are bitwise-comparable; the oracle is
+the reference's cross-backend consistency criterion (SURVEY.md §4.2)
+applied to our two TPU execution paths.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.ops import pallas_train
+from hpnn_tpu.train import loop
+
+
+def _setup(seed, n_in, hiddens, n_out, snn=False, hot=2):
+    k, _ = kernel_mod.generate(seed, n_in, hiddens, n_out)
+    weights = tuple(jnp.asarray(np.asarray(w), dtype=jnp.float32) for w in k.weights)
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.uniform(-1, 1, n_in), dtype=jnp.float32)
+    lo = 0.0 if snn else -1.0
+    t = jnp.asarray(np.where(np.arange(n_out) == hot, 1.0, lo), dtype=jnp.float32)
+    return weights, x, t
+
+
+@pytest.mark.parametrize("model,momentum", [
+    ("ann", False), ("ann", True), ("snn", False), ("snn", True),
+])
+def test_fused_matches_lax(model, momentum):
+    weights, x, t = _setup(99, 12, [16, 8], 8, snn=(model == "snn"))
+    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    kw = dict(model=model, momentum=momentum, min_iter=5, max_iter=60)
+
+    ref = loop.train_sample_lax(weights, dw, x, t, 0.2, 1e-6, **kw)
+    got = pallas_train.train_sample_fused(
+        weights, dw, x, t, 0.2, 1e-6, interpret=True, **kw
+    )
+
+    assert int(got.n_iter) == int(ref.n_iter)
+    assert bool(got.first_ok) == bool(ref.first_ok)
+    assert bool(got.final_ok) == bool(ref.final_ok)
+    np.testing.assert_allclose(float(got.ep0), float(ref.ep0), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(got.out), np.asarray(ref.out), atol=1e-6
+    )
+    for a, b in zip(got.weights, ref.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    if momentum:
+        for a, b in zip(got.dw, ref.dw):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_fused_deep_kernel():
+    """Three hidden layers exercise the static depth unrolling."""
+    weights, x, t = _setup(7, 10, [12, 8, 6], 4)
+    ref = loop.train_sample_lax(
+        weights, (), x, t, 0.2, 1e-6,
+        model="ann", momentum=False, min_iter=3, max_iter=30,
+    )
+    got = pallas_train.train_sample_fused(
+        weights, (), x, t, 0.2, 1e-6,
+        model="ann", momentum=False, min_iter=3, max_iter=30, interpret=True,
+    )
+    assert int(got.n_iter) == int(ref.n_iter)
+    for a, b in zip(got.weights, ref.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
