@@ -80,6 +80,33 @@ def extract_long_opts(argv: list[str], *, flags=(), valued=()):
     return rest, out
 
 
+class profile_trace:
+    """Optional ``jax.profiler`` trace around a workload (--profile DIR).
+
+    The reference has no profiler of its own — it relies on external
+    tools (``nvcc -lineinfo`` for nvprof, ref: configure.ac:535); the
+    TPU-native equivalent is an XLA trace viewable in XProf/TensorBoard
+    (SURVEY.md §5 "Tracing / profiling").
+    """
+
+    def __init__(self, trace_dir: str | None):
+        self.trace_dir = trace_dir
+
+    def __enter__(self):
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+        return self
+
+    def __exit__(self, *exc):
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+        return False
+
+
 def validate_long_opts(opts: dict) -> bool:
     """Value checks for the TPU-side long options; prints the CLI's
     usual ``syntax error`` style instead of raising."""

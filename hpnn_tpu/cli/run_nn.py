@@ -16,7 +16,9 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     common.install_sigpipe_handler()
     runtime.init_all(1)
-    argv, opts = common.extract_long_opts(argv, flags=("batch",))
+    argv, opts = common.extract_long_opts(
+        argv, flags=("batch",), valued=("profile",)
+    )
     if argv is None:
         runtime.deinit_all()
         return -1
@@ -29,12 +31,13 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
-    if opts.get("batch"):
-        from hpnn_tpu.train import batch as batch_mod
+    with common.profile_trace(opts.get("profile")):
+        if opts.get("batch"):
+            from hpnn_tpu.train import batch as batch_mod
 
-        batch_mod.run_kernel_batched(conf)
-    else:
-        driver.run_kernel(conf)
+            batch_mod.run_kernel_batched(conf)
+        else:
+            driver.run_kernel(conf)
     runtime.deinit_all()
     return 0
 

@@ -18,7 +18,7 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
-        argv, valued=("batch", "epochs", "mesh")
+        argv, valued=("batch", "epochs", "mesh", "profile")
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
@@ -43,17 +43,18 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
         runtime.deinit_all()
         return -1
-    if "batch" in opts:
-        from hpnn_tpu.train import batch as batch_mod
+    with common.profile_trace(opts.get("profile")):
+        if "batch" in opts:
+            from hpnn_tpu.train import batch as batch_mod
 
-        ok = batch_mod.train_kernel_batched(
-            conf,
-            batch_size=int(opts["batch"]),
-            epochs=int(opts.get("epochs", "1")),
-            mesh_spec=opts.get("mesh"),
-        )
-    else:
-        ok = driver.train_kernel(conf)
+            ok = batch_mod.train_kernel_batched(
+                conf,
+                batch_size=int(opts["batch"]),
+                epochs=int(opts.get("epochs", "1")),
+                mesh_spec=opts.get("mesh"),
+            )
+        else:
+            ok = driver.train_kernel(conf)
     if not ok:
         sys.stderr.write("FAILED to train kernel!\n")
         runtime.deinit_all()

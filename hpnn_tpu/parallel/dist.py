@@ -1,0 +1,78 @@
+"""Multi-host / multi-slice distributed setup.
+
+Replaces the reference's MPI process model (``mpirun -np X`` +
+``MPI_Init``/``MPI_COMM_WORLD``, ref: /root/reference/src/libhpnn.c:
+182-200) with the JAX distributed runtime:
+
+* every host runs the same ``train_nn`` invocation with
+  ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+  ``JAX_PROCESS_ID`` set (the coordinator replaces ``mpirun``);
+  ``runtime.init_dist`` joins the cluster during ``_NN(init,all)``;
+* collectives then ride ICI within a slice and DCN across slices —
+  :func:`hybrid_mesh` lays the ``data`` axis across DCN (gradient
+  allreduce once per step) and keeps the ``model`` axis inside a slice
+  (activation all_gather per layer), matching the bandwidth hierarchy;
+* rank-0-only printing (the reference's ``_OUT``) is already wired
+  through utils/logging via ``jax.process_index()``.
+
+The reference's load-time MPI bail-out protocol (rank 0 notifies
+slaves of a parse failure, ref: src/ann.c:242-248) needs no equivalent:
+config parsing happens identically on every process before any
+collective is traced, so a parse failure exits all processes without
+deadlock.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hpnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def hybrid_mesh(n_model: int = 1, devices=None):
+    """A ``(data, model)`` mesh that spans hosts/slices correctly.
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` when more than one
+    slice is attached (data axis over DCN, model axis over ICI) and a
+    plain contiguous mesh otherwise.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % n_model != 0:
+        raise ValueError(f"{n} devices not divisible by n_model={n_model}")
+    n_data = n // n_model
+    num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if num_slices > 1:
+        if n_data % num_slices != 0:
+            raise ValueError(
+                f"data axis ({n_data}) must be divisible by the slice "
+                f"count ({num_slices}): the model axis (n_model={n_model}) "
+                f"cannot span slices"
+            )
+        dev = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(n_data // num_slices, n_model),
+            dcn_mesh_shape=(num_slices, 1),
+            devices=devices,
+        )
+    else:
+        try:
+            dev = mesh_utils.create_device_mesh((n_data, n_model), devices=devices)
+        except (ValueError, AssertionError):
+            dev = np.asarray(devices).reshape(n_data, n_model)
+    return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
+
+
+def process_summary() -> str:
+    """One-line cluster summary for logs (rank, #procs, local devices)."""
+    import jax
+
+    return (
+        f"process {jax.process_index()}/{jax.process_count()} "
+        f"local_devices={jax.local_device_count()} "
+        f"global_devices={jax.device_count()}"
+    )

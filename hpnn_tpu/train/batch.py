@@ -41,13 +41,23 @@ def _compute_dtype():
 
 def default_mesh(spec: str | None = None):
     """``data×model`` mesh from a "DxM" spec string, or all devices on
-    the data axis (pure DP) by default."""
+    the data axis (pure DP) by default.
+
+    Slice-aware: when the spec covers every attached device (or no spec
+    is given), the mesh comes from ``dist.hybrid_mesh`` so that on a
+    multi-slice pod the data axis rides DCN and the model axis stays
+    inside a slice; a sub-mesh spec falls back to a contiguous mesh.
+    """
     import jax
 
+    from hpnn_tpu.parallel import dist
+
     if spec:
-        d, m = spec.lower().split("x")
-        return mesh_mod.make_mesh(n_data=int(d), n_model=int(m))
-    return mesh_mod.make_mesh(n_data=len(jax.devices()), n_model=1)
+        d, m = (int(v) for v in spec.lower().split("x"))
+        if d * m == jax.device_count():
+            return dist.hybrid_mesh(n_model=m)
+        return mesh_mod.make_mesh(n_data=d, n_model=m)
+    return dist.hybrid_mesh(n_model=1)
 
 
 def _model_of(conf: NNConf) -> str:

@@ -1,0 +1,30 @@
+"""Driver hooks: entry() compile-check and multi-chip dry run on the
+8-virtual-device CPU mesh (what the external driver does)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_entry_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (10,)
+
+
+def test_dryrun_multichip_8(capsys):
+    graft.dryrun_multichip(8)
+    out = capsys.readouterr().out
+    assert "dryrun gspmd: mesh=2x4" in out
+    assert "dryrun tp:" in out
+
+
+def test_dryrun_multichip_2(capsys):
+    graft.dryrun_multichip(2)
+    out = capsys.readouterr().out
+    assert "dryrun gspmd: mesh=1x2" in out
