@@ -59,6 +59,10 @@ class NNConf:
     train: NNTrain = NNTrain.UKN
     samples: str | None = None
     tests: str | None = None
+    # the KERNEL's own name: None for generated kernels (the reference
+    # never names them, so ann_dump prints glibc's "(null)" — ref:
+    # src/ann.c:632-766 vs :796), the file's [name] token after a load
+    kernel_name: str | None = None
 
 
 def _value_after(line: str, tag: str, skip: int) -> str:
@@ -238,6 +242,7 @@ def generate_kernel(conf: NNConf, n_in: int, hiddens: list[int], n_out: int) -> 
     k, seed = kernel_mod.generate(conf.seed, n_in, hiddens, n_out)
     conf.seed = seed
     conf.kernel = k
+    conf.kernel_name = None  # generated kernels are unnamed (ref parity)
     return True
 
 
@@ -252,6 +257,10 @@ def load_kernel(conf: NNConf) -> bool:
     if name and not conf.name:
         conf.name = name
     conf.kernel = k
+    # keep the file's name verbatim, even when blank — the reference
+    # substitutes "noname" only for a NULL strdup (zero-length source,
+    # ref: src/ann.c:268-269), not for an empty parsed name
+    conf.kernel_name = name
     return True
 
 
@@ -259,4 +268,10 @@ def dump_kernel(conf: NNConf, fp) -> None:
     if conf.kernel is None:
         log.nn_error(sys.stderr, "CAN'T SAVE KERNEL! kernel=NULL\n")
         return
-    kernel_mod.dump(conf.name or "unnamed", conf.kernel, fp)
+    # generated kernels have no name; the reference's printf renders the
+    # NULL as "(null)" and that literal round-trips through later loads
+    kernel_mod.dump(
+        conf.kernel_name if conf.kernel_name is not None else "(null)",
+        conf.kernel,
+        fp,
+    )

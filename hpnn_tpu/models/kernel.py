@@ -83,10 +83,12 @@ def generate(
         rng = GlibcRandom(seed)
         arrs = []
         for n, m in shapes:
-            scale = 1.0 / np.sqrt(float(m))
+            # division (not multiply-by-reciprocal): bit-identical to
+            # the reference's 2*(u-0.5)/sqrt(M) (ref: src/ann.c:677)
+            sqrt_m = np.sqrt(float(m))
             vals = np.empty(n * m, dtype=np.float64)
             for j in range(n * m):
-                vals[j] = 2.0 * (rng.random() / RAND_MAX - 0.5) * scale
+                vals[j] = 2.0 * (rng.random() / RAND_MAX - 0.5) / sqrt_m
             arrs.append(vals.reshape(n, m))
     weights = [a.astype(dtype) for a in arrs]
     return Kernel(tuple(weights)), seed

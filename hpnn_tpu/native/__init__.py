@@ -126,7 +126,7 @@ def glibc_weight_stream(seed: int, layer_shapes):
             L.glibc_weights(
                 h,
                 n * m,
-                1.0 / np.sqrt(float(m)),
+                np.sqrt(float(m)),
                 arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             )
             outs.append(arr.reshape(n, m))

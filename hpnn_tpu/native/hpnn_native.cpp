@@ -84,11 +84,12 @@ void glibc_fill(void* h, int64_t n, int32_t* out) {
   for (int64_t i = 0; i < n; ++i) out[i] = rng_next(g);
 }
 
-// n weights 2*(random()/RAND_MAX - 0.5)*scale (ref: src/ann.c:700-706)
-void glibc_weights(void* h, int64_t n, double scale, double* out) {
+// n weights 2*(random()/RAND_MAX - 0.5)/sqrt_m — division, exactly as
+// the reference computes it (ref: src/ann.c:677,702)
+void glibc_weights(void* h, int64_t n, double sqrt_m, double* out) {
   GlibcRng* g = (GlibcRng*)h;
   for (int64_t i = 0; i < n; ++i)
-    out[i] = 2.0 * ((double)rng_next(g) / kRandMax - 0.5) * scale;
+    out[i] = 2.0 * ((double)rng_next(g) / kRandMax - 0.5) / sqrt_m;
 }
 
 // The training/eval file-visit order: draw slots in [0,n) with

@@ -35,9 +35,9 @@ def test_weight_stream_matches_python():
     got = native.glibc_weight_stream(1234, shapes)
     rng = GlibcRandom(1234)
     for n, m in shapes:
-        scale = 1.0 / np.sqrt(float(m))
+        sqrt_m = np.sqrt(float(m))
         want = np.array(
-            [2.0 * (rng.random() / RAND_MAX - 0.5) * scale for _ in range(n * m)]
+            [2.0 * (rng.random() / RAND_MAX - 0.5) / sqrt_m for _ in range(n * m)]
         ).reshape(n, m)
         np.testing.assert_array_equal(got.pop(0), want)
 
