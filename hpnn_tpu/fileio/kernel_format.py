@@ -70,7 +70,11 @@ def load_kernel(path: str) -> tuple[str, list[np.ndarray]]:
     # pass 1: dims from [param]
     for line in lines:
         if "[name" in line:
-            name = _first_token(line[line.find("[name") + 6 :])
+            # the kernel parser keeps the WHOLE rest of the line (spaces
+            # included, newlines stripped) — unlike the .conf parser's
+            # STR_CLEAN first-token rule (ref: src/ann.c:266-277)
+            rest = line[line.find("[name") + 6 :].lstrip(" \t")
+            name = rest.replace("\n", "") if rest else "noname"
         if "[param" in line:
             dims = _ints_after(line, "[param")
             if len(dims) < 3:

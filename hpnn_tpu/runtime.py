@@ -170,7 +170,13 @@ def init_tpu() -> bool:
 
 
 def init_all(init_verbose: int = 0) -> int:
-    """``_NN(init,all)`` equivalent (ref: src/libhpnn.c:326-347)."""
+    """``_NN(init,all)`` equivalent (ref: src/libhpnn.c:326-347).
+
+    Like the reference, ``init_verbose`` applies only DURING init and is
+    reset to 0 before returning (ref: src/libhpnn.c:344) — the CLIs'
+    ``-v`` flags then raise it from 0, so ``-v -v`` behaves identically
+    to the C binaries.
+    """
     global _initialized
     init_runtime()
     if init_verbose:
@@ -187,6 +193,7 @@ def init_all(init_verbose: int = 0) -> int:
         _runtime.nn_num_tasks,
         _runtime.nn_num_threads,
     )
+    set_verbose(0)
     return 0
 
 

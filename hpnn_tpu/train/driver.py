@@ -176,7 +176,7 @@ def print_verdict(out: np.ndarray, target: np.ndarray, model: str) -> None:
     else:
         # ref: src/libhpnn.c:1489-1514 — threshold 0.1, plus the
         # BEST CLASS token and -vvv probability table
-        log.nn_dbg(sys.stdout, " CLASS | PROBABILITY (%%)\n")
+        log.nn_dbg(sys.stdout, " CLASS | PROBABILITY (%s)\n", "%")
         log.nn_dbg(sys.stdout, "-------|----------------\n")
         for idx in range(out.shape[0]):
             log.nn_dbg(sys.stdout, " %5i | %15.10f\n", idx + 1, out[idx] * 100.0)

@@ -28,7 +28,11 @@ def set_verbose(v: int) -> None:
 
 def inc_verbose() -> None:
     global _verbosity
+    if _verbosity > 2:  # capped at 3, like the reference (src/libhpnn.c:71)
+        return
     _verbosity += 1
+    # the reference reports the change at DBG level (fires at the 3rd -v)
+    nn_dbg(sys.stdout, "verbosity set to %i.\n", _verbosity)
 
 
 def dec_verbose() -> None:

@@ -33,15 +33,14 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-@pytest.fixture(scope="module")
-def ref_binary(tmp_path_factory):
+def _build(tmp_path_factory, main_src: str, name: str):
     d = tmp_path_factory.mktemp("refbuild")
-    exe = d / "train_nn_ref"
+    exe = d / name
     res = subprocess.run(
         [
             "gcc", "-O2", f"-I{REF}/include",
             f"{REF}/src/libhpnn.c", f"{REF}/src/ann.c", f"{REF}/src/snn.c",
-            f"{REF}/tests/train_nn.c", "-lm", "-o", str(exe),
+            main_src, "-lm", "-o", str(exe),
         ],
         capture_output=True,
         text=True,
@@ -51,37 +50,71 @@ def ref_binary(tmp_path_factory):
     return exe
 
 
-def _workload(d, n=4, n_in=8, n_out=3):
+@pytest.fixture(scope="module")
+def ref_binary(tmp_path_factory):
+    return _build(tmp_path_factory, f"{REF}/tests/train_nn.c", "train_nn_ref")
+
+
+@pytest.fixture(scope="module")
+def ref_run_binary(tmp_path_factory):
+    return _build(tmp_path_factory, f"{REF}/tests/run_nn.c", "run_nn_ref")
+
+
+def _workload(d, n=4, n_in=8, n_out=3, nn_type="ANN", train="BP", snn=False):
     sdir = d / "samples"
     sdir.mkdir()
     rng = np.random.RandomState(11)
     for i in range(n):
         x = rng.uniform(-1, 1, n_in)
-        t = np.full(n_out, -1.0)
+        t = np.full(n_out, 0.0 if snn else -1.0)
         t[i % n_out] = 1.0
         with open(sdir / f"s{i:05d}.txt", "w") as fp:
             fp.write(f"[input] {n_in}\n" + " ".join(f"{v:7.5f}" for v in x) + "\n")
             fp.write(f"[output] {n_out}\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
     (d / "nn.conf").write_text(
-        "[name] P\n[type] ANN\n[init] generate\n[seed] 777\n"
-        f"[input] {n_in}\n[hidden] 6\n[output] {n_out}\n[train] BP\n"
+        f"[name] P\n[type] {nn_type}\n[init] generate\n[seed] 777\n"
+        f"[input] {n_in}\n[hidden] 6\n[output] {n_out}\n[train] {train}\n"
         "[sample_dir] ./samples\n[test_dir] ./samples\n"
     )
 
 
-def _tokens(text):
-    return [ln for ln in text.splitlines() if "TRAINING FILE" in ln]
+def _tokens(text, what="TRAINING FILE"):
+    return [ln for ln in text.splitlines() if what in ln]
 
 
-def test_training_parity_vs_reference(ref_binary, tmp_path):
+def _run_ours(tmp_path, cli_main, argv):
+    import contextlib
+    import io
+
+    from hpnn_tpu.utils import logging as log
+
+    cwd = os.getcwd()
+    buf = io.StringIO()
+    old_verbose = log.get_verbose()
+    try:
+        os.chdir(tmp_path)
+        with contextlib.redirect_stdout(buf):
+            assert cli_main(argv) == 0
+    finally:
+        os.chdir(cwd)
+        log.set_verbose(old_verbose)
+    return buf.getvalue()
+
+
+@pytest.mark.parametrize("nn_type,train,snn", [
+    ("ANN", "BP", False),
+    ("ANN", "BPM", False),
+    ("SNN", "BP", True),
+    ("SNN", "BPM", True),
+])
+def test_training_parity_vs_reference(ref_binary, tmp_path, nn_type, train, snn):
     from hpnn_tpu.cli import train_nn as cli
     from hpnn_tpu.fileio import kernel_format
 
-    _workload(tmp_path)
-    # reference run
+    _workload(tmp_path, nn_type=nn_type, train=train, snn=snn)
     res = subprocess.run(
         [str(ref_binary), "-v", "-v", "-v", "nn.conf"],
-        cwd=tmp_path, capture_output=True, text=True, timeout=300,
+        cwd=tmp_path, capture_output=True, text=True, timeout=500,
     )
     ref_out = res.stdout + res.stderr
     assert res.returncode == 0, f"reference run failed:\n{ref_out[:2000]}"
@@ -90,24 +123,9 @@ def test_training_parity_vs_reference(ref_binary, tmp_path):
     (tmp_path / "kernel.tmp").unlink()
     (tmp_path / "kernel.opt").unlink()
 
-    # our run, in-process (conftest already forces cpu + x64)
-    import contextlib
-    import io
+    ours_out = _run_ours(tmp_path, cli.main, ["-v", "-v", "-v", "nn.conf"])
 
-    cwd = os.getcwd()
-    buf = io.StringIO()
-    from hpnn_tpu.utils import logging as log
-
-    old_verbose = log.get_verbose()
-    try:
-        os.chdir(tmp_path)
-        with contextlib.redirect_stdout(buf):
-            assert cli.main(["-v", "-v", "-v", "nn.conf"]) == 0
-    finally:
-        os.chdir(cwd)
-        log.set_verbose(old_verbose)
-
-    assert _tokens(buf.getvalue()) == _tokens(ref_out)
+    assert _tokens(ours_out) == _tokens(ref_out)
     assert (tmp_path / "kernel.tmp").read_text() == ref_tmp
 
     # trained weights: reference's cross-backend bar
@@ -117,3 +135,33 @@ def test_training_parity_vs_reference(ref_binary, tmp_path):
     for a, b in zip(ref_w, ours_w):
         assert abs(np.abs(a).sum() - np.abs(b).sum()) < 1e-10
         assert np.abs(a - b).max() < 1e-10
+
+
+@pytest.mark.parametrize("nn_type,snn", [("ANN", False), ("SNN", True)])
+def test_eval_parity_vs_reference(ref_binary, ref_run_binary, tmp_path,
+                                  nn_type, snn):
+    """run_nn verdict tokens match the reference binary's, including the
+    SNN BEST CLASS line."""
+    from hpnn_tpu.cli import run_nn as cli
+
+    _workload(tmp_path, nn_type=nn_type, snn=snn)
+    res = subprocess.run(
+        [str(ref_binary), "nn.conf"],  # train silently, writes kernel.opt
+        cwd=tmp_path, capture_output=True, text=True, timeout=500,
+    )
+    assert res.returncode == 0
+    conf = (tmp_path / "nn.conf").read_text().replace(
+        "[init] generate", "[init] kernel.opt"
+    )
+    (tmp_path / "cont.conf").write_text(conf)
+
+    res = subprocess.run(
+        [str(ref_run_binary), "-v", "-v", "cont.conf"],
+        cwd=tmp_path, capture_output=True, text=True, timeout=300,
+    )
+    ref_out = res.stdout + res.stderr
+    assert res.returncode == 0, ref_out[:2000]
+
+    ours_out = _run_ours(tmp_path, cli.main, ["-v", "-v", "cont.conf"])
+    assert _tokens(ours_out, "TESTING FILE") == _tokens(ref_out, "TESTING FILE")
+    assert _tokens(ref_out, "TESTING FILE")  # non-empty
