@@ -28,3 +28,39 @@ def test_dryrun_multichip_2(capsys):
     graft.dryrun_multichip(2)
     out = capsys.readouterr().out
     assert "dryrun gspmd: mesh=1x2" in out
+
+
+def test_dryrun_without_cpu_shield():
+    """Reproduce the DRIVER's environment (round-1 RED gate): no forced
+    JAX_PLATFORMS=cpu, so the default platform may resolve to a real
+    accelerator client.  The dryrun must still run entirely on the
+    virtual CPU devices and never initialize/touch the default client."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("JAX_ENABLE_X64", None)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import __graft_entry__ as g; g.dryrun_multichip(8)",
+        ],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr}\nstdout:\n{proc.stdout}"
+    assert "dryrun gspmd: mesh=2x4" in proc.stdout
+    assert "dryrun tp:" in proc.stdout
