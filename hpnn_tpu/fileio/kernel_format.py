@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from hpnn_tpu.fileio import samples
+
 
 class KernelFormatError(ValueError):
     pass
@@ -133,17 +135,14 @@ def load_kernel(path: str) -> tuple[str, list[np.ndarray]]:
             i += 1
             if i >= len(lines):
                 raise KernelFormatError("EOF while reading neuron weights")
-            # first cur_m tokens only: the reference's GET_DOUBLE loop
-            # ignores anything after the M-th weight on the line
-            try:
-                row = np.array(lines[i].split()[:cur_m], dtype=np.float64)
-            except ValueError as exc:
+            # first cur_m values via the shared GET_DOUBLE walk (junk
+            # tokens read as 0.0, numeric prefixes are salvaged, extra
+            # tokens past the M-th are ignored — a row is never
+            # rejected, exactly like ann_load; see samples.parse_row)
+            row = samples.parse_row(lines[i], cur_m)
+            if row is None:  # absurd declared width only
                 raise KernelFormatError(
-                    f"layer {layer_idx}: bad weight token: {exc}"
-                ) from None
-            if row.size < cur_m:
-                raise KernelFormatError(
-                    f"layer {layer_idx}: neuron row has {row.size} < {cur_m} weights"
+                    f"layer {layer_idx}: implausible neuron width {cur_m}"
                 )
             rows.append(row)
         i += 1

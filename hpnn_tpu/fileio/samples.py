@@ -20,7 +20,15 @@ import re
 
 import numpy as np
 
-_FLOAT_PREFIX = re.compile(r"[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?")
+# strtod: optional whitespace then a decimal number ("inf"/"nan"/hex
+# floats parse in C but are never written by any converter — out of
+# scope, same note as round 1)
+_STRTOD = re.compile(r"\s*([-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?)")
+
+# Guard against absurd declared counts ([input] 999999999): the
+# reference ALLOCs exactly that many doubles (exit(-1) on failure) and
+# walks garbage memory past the line's NUL; we reject instead.
+_SANE_ROW = 1 << 22
 
 
 def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
@@ -38,7 +46,7 @@ def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
             n = _count_after(line, "[input")
             if n is None or n == 0 or i + 1 >= len(lines):
                 return None
-            vin = _parse_row(lines[i + 1], n)
+            vin = parse_row(lines[i + 1], n)
             if vin is None:
                 return None
             i += 1
@@ -46,7 +54,7 @@ def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
             n = _count_after(line, "[output")
             if n is None or n == 0 or i + 1 >= len(lines):
                 return None
-            vout = _parse_row(lines[i + 1], n)
+            vout = parse_row(lines[i + 1], n)
             if vout is None:
                 return None
             i += 1
@@ -56,32 +64,51 @@ def read_sample(path: str) -> tuple[np.ndarray, np.ndarray] | None:
     return vin, vout
 
 
-def _parse_row(line: str, n: int) -> np.ndarray | None:
-    """First ``n`` whitespace-separated doubles of the line (the
-    reference's GET_DOUBLE loop ignores trailing junk)."""
+def parse_row(line: str, n: int) -> np.ndarray | None:
+    """``n`` doubles from the line via the reference's exact GET_DOUBLE
+    walk (ref: src/ann.c:438-444, src/libhpnn.c:1104-1110; macros
+    common.h:250-274,272-274,290-295), shared by the sample reader and
+    the kernel loader:
+
+    * ``v = strtod(p, &end)`` — 0.0 when the token is junk (``end==p``;
+      the reference's ``ASSERT_GOTO(end,FAIL)`` is a NULL check that
+      can never fire, so a row is NEVER rejected);
+    * cursor always advances ``end+1`` then SKIP_BLANK, so a junk
+      token reads as 0.0 and a junk-suffixed token ("0.25x 0.5")
+      salvages its prefix and scanning continues after it;
+    * a line with fewer than ``n`` values yields 0.0 for the missing
+      ones (the C walks leftover buffer bytes there — undefined; we
+      define them as 0.0).
+
+    Returns None only for an absurd ``n`` (see ``_SANE_ROW``)."""
     from hpnn_tpu import native
 
+    if n > max(len(line) // 2 + 1, _SANE_ROW):
+        return None
+    out = np.zeros(n, dtype=np.float64)
     row = native.parse_doubles(line, n)
     if row is not None:
-        return row if row.size == n else None
-    # strtod-like fallback: parse tokens until one fails, salvaging a
-    # leading numeric prefix like strtod does ("2.5x" -> 2.5, stop).
-    # (C99 hex floats parse natively but not here; neither converter
-    # ever writes them.)
-    out: list[float] = []
-    for tok in line.split():
-        if len(out) >= n:
-            break
-        try:
-            out.append(float(tok))
-        except ValueError:
-            m = _FLOAT_PREFIX.match(tok)
-            if m:
-                out.append(float(m.group(0)))
-            break
-    if len(out) < n:
-        return None
-    return np.array(out, dtype=np.float64)
+        out[: row.size] = row
+        return out
+    # pure-Python fallback: the same walk
+    pos, limit = 0, len(line)
+    for k in range(n):
+        if pos > limit:
+            break  # past the "NUL": remaining values stay 0.0
+        m = _STRTOD.match(line, pos)
+        if m:
+            out[k] = float(m.group(1))
+            pos = m.end() + 1
+        else:
+            pos += 1  # strtod failure: end == start, ptr = end+1
+        # SKIP_BLANK: non-graph chars except newline (common.h:250-251)
+        while (
+            pos < limit
+            and line[pos] != "\n"
+            and (line[pos].isspace() or not line[pos].isprintable())
+        ):
+            pos += 1
+    return out
 
 
 def read_dir(directory: str):

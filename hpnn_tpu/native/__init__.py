@@ -144,10 +144,11 @@ def parse_doubles(text: str | bytes, maxn: int):
         return None
     if isinstance(text, str):
         text = text.encode()
-    # maxn may come from an untrusted file header; the line can hold at
-    # most (len+1)/2 numbers (1 char + separator each), so bound the
-    # allocation by the text itself
-    maxn = min(maxn, len(text) // 2 + 1)
+    # maxn may come from an untrusted file header; the GET_DOUBLE walk
+    # advances at least one char per slot while inside the line, so at
+    # most len+1 slots are written — bound the allocation by that (the
+    # caller zero-fills the remainder, same as values past the line)
+    maxn = min(maxn, len(text) + 1)
     out = np.empty(maxn, dtype=np.float64)
     got = L.parse_doubles(
         text, maxn, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))

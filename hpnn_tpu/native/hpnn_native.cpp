@@ -110,17 +110,28 @@ void glibc_shuffle(uint32_t seed, int64_t n, int32_t* out) {
   free(taken);
 }
 
-// Parse up to maxn whitespace-separated doubles from buf (strtod
-// semantics, like the reference's GET_DOUBLE loops). Returns count.
+// Parse up to maxn doubles from buf with the EXACT walk of the
+// reference's GET_DOUBLE loops (ref: src/ann.c:438-444,
+// src/libhpnn.c:1104-1110):
+//   v = strtod(p, &end);        // 0.0 when end == p (failure)
+//   ASSERT_GOTO(end, FAIL);     // NULL check — can never fire
+//   p = end + 1; SKIP_BLANK(p); // skip non-graph except '\n'/'\0'
+// A junk token therefore reads as 0.0 and the cursor advances one
+// char; a junk-suffixed token ("0.25x") salvages its numeric prefix
+// and scanning continues after it; a row can never be rejected.
+// Returns how many slots were written before the line ran out (the C
+// walks leftover buffer bytes past the NUL there — callers define the
+// missing values as 0.0).
 int64_t parse_doubles(const char* buf, int64_t maxn, double* out) {
+  const char* lim = buf + strlen(buf);
   const char* p = buf;
   char* end;
   int64_t count = 0;
-  while (count < maxn) {
+  while (count < maxn && p <= lim) {
     double v = strtod(p, &end);
-    if (end == p) break;
-    out[count++] = v;
-    p = end;
+    out[count++] = (end == p) ? 0.0 : v;
+    p = end + 1;  // end == p on failure, so this always advances 1+
+    while (p < lim && *p != '\n' && !(*p > ' ' && *p < 0x7f)) ++p;
   }
   return count;
 }

@@ -4,10 +4,16 @@ Replaces ``scripts/gen_ann.bash`` (ref: /root/reference/scripts/
 gen_ann.bash:38-47), which draws 16-bit words from /dev/urandom,
 formats them as 5-digit zero-padded decimals and reads them back as
 ``0.ddddd`` — i.e. u = v/100000 with v ∈ [0,65535] (a quirky,
-negatively-biased uniform) — then writes ``2·(u−0.5)/√M`` weights as
-``%7.5f`` with a trailing space per row.  Same math and format here,
+negatively-biased uniform) — then writes ``2·(u−0.5)/√width`` weights
+as ``%7.5f`` with a trailing space per row.  Same math and format here,
 with an optional ``--seed`` for reproducibility (the bash tool was
 unseedable).
+
+Scale quirk preserved: the awk call passes ``var="$param $WEIGHT"`` so
+``list[1]`` is the CURRENT layer's neuron count, i.e. the divisor is
+√(layer width) — NOT √(fan-in) as ``ann_generate`` uses
+(ref: src/ann.c:677).  For non-square layers the two differ; this tool
+reproduces the script, not the library.
 
 usage: gen_ann [--seed N] num_input num_hid1 [... num_hidN] num_output
 """
@@ -73,7 +79,9 @@ def main(argv: list[str] | None = None) -> int:
             w("[output] %i\n" % width)
         else:
             w("[hidden %i] %i\n" % (li, width))
-        scale = 1.0 / math.sqrt(prev)
+        # the bash tool divides by sqrt(CURRENT width), not fan-in
+        # (awk list[1] == $param, ref: scripts/gen_ann.bash:38-47)
+        scale = 1.0 / math.sqrt(width)
         for j in range(1, width + 1):
             w("[neuron %i] %i\n" % (j, prev))
             row = (

@@ -64,3 +64,37 @@ def test_validate():
     assert kernel_mod.validate(k)
     bad = kernel_mod.Kernel((np.zeros((5, 4)), np.zeros((2, 9))))
     assert not kernel_mod.validate(bad)
+
+
+def test_load_salvages_strtod_prefix(tmp_path):
+    """The GET_DOUBLE walk salvages the numeric prefix of a
+    junk-suffixed token ("0.25x" -> 0.25) and keeps scanning after it
+    (ref: src/ann.c:438-444, common.h:272-274)."""
+    p = tmp_path / "junk.txt"
+    p.write_text(
+        "[name] j\n[param] 2 2 1\n[input] 2\n"
+        "[hidden 1] 2\n"
+        "[neuron 1] 2\n0.125 0.25x\n"
+        "[neuron 2] 2\n-0.5x 0.75 trailing ignored\n"
+        "[output] 1\n"
+        "[neuron 1] 2\n1.0 -1.0\n"
+    )
+    _, ws = load_kernel(str(p))
+    assert np.allclose(ws[0], [[0.125, 0.25], [-0.5, 0.75]])
+
+
+def test_load_junk_token_reads_zero(tmp_path):
+    """A junk token reads as 0.0 and a short row zero-fills: the
+    reference's ASSERT_GOTO(end,FAIL) is a NULL check strtod can never
+    trigger, so ann_load cannot reject a weight row
+    (ref: src/ann.c:438-444, common.h:290-295)."""
+    p = tmp_path / "zeros.txt"
+    p.write_text(
+        "[name] j\n[param] 2 2 1\n[input] 2\n"
+        "[hidden 1] 2\n"
+        "[neuron 1] 2\nx 0.5\n"
+        "[neuron 2] 2\n0.25\n"
+        "[output] 1\n[neuron 1] 2\n1.0 -1.0\n"
+    )
+    _, ws = load_kernel(str(p))
+    assert np.allclose(ws[0], [[0.0, 0.5], [0.25, 0.0]])

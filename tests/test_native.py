@@ -61,10 +61,33 @@ def test_shuffle_matches_python():
 
 
 def test_parse_doubles():
+    # GET_DOUBLE walk: each junk char reads as 0.0 and the cursor
+    # advances one char, so "junk" yields four zeros before the 7
     got = native.parse_doubles("  1.5 -2.25e1 0.125 junk 7", 10)
-    np.testing.assert_array_equal(got, [1.5, -22.5, 0.125])
+    np.testing.assert_array_equal(got, [1.5, -22.5, 0.125, 0, 0, 0, 0, 7.0])
     got = native.parse_doubles("1 2 3 4", 2)
     np.testing.assert_array_equal(got, [1.0, 2.0])
+
+
+def test_parse_row_matches_python_walk(monkeypatch):
+    """Native strtod walk and the pure-Python fallback agree."""
+    from hpnn_tpu.fileio.samples import parse_row
+
+    lines = [
+        "  1.5 -2.25e1 0.125 junk 7",
+        "0.25x 0.5",
+        "x 0.5",
+        "1.0junk2.0 3",
+        "",
+        "only 2 number-ish 4x",
+        "xxxxx 1.0",  # junk-heavy: each junk char consumes a slot
+        "!!!!!!!!!! 9",  # more junk chars than len//2 slots
+    ]
+    assert native.lib() is not None  # else this compares fallback to itself
+    natives = [parse_row(line, 8) for line in lines]
+    monkeypatch.setenv("HPNN_NO_NATIVE", "1")
+    for line, a in zip(lines, natives):
+        np.testing.assert_array_equal(a, parse_row(line, 8), err_msg=repr(line))
 
 
 def test_no_native_env_disables(monkeypatch):

@@ -127,10 +127,17 @@ def test_gen_ann_loadable(tmp_path, capsys):
     name, k = kernel_mod.load(str(kfile))
     assert name == "auto"
     assert k.n_inputs == 8 and k.hidden_sizes == (6,) and k.n_outputs == 4
-    # weights within the quirky urandom range: 2*(v/100000-0.5)/sqrt(M)
-    w = np.concatenate([np.asarray(x).ravel() for x in k.weights])
-    assert w.min() >= -1.0 / np.sqrt(6) - 1e-9
-    assert w.max() <= 2 * (65535 / 100000 - 0.5) / np.sqrt(6) + 1e-9
+    # per-layer scale quirk: the bash tool divides by sqrt(CURRENT
+    # layer width) — sqrt(6) then sqrt(4) here — not sqrt(fan-in)
+    # (awk list[1] == $param, ref: scripts/gen_ann.bash:38-47)
+    for w, width in zip(k.weights, (6, 4)):
+        w = np.asarray(w).ravel()
+        assert w.min() >= -1.0 / np.sqrt(width) - 1e-9
+        assert w.max() <= 2 * (65535 / 100000 - 0.5) / np.sqrt(width) + 1e-9
+    # the output layer (width 4, fan-in 6) must actually use sqrt(4):
+    # with 24 u16 draws the max |w| exceeds the 1/sqrt(6) bound w.h.p.
+    out = np.abs(np.asarray(k.weights[-1])).ravel()
+    assert out.max() > 1.0 / np.sqrt(6)
 
 
 def test_gen_ann_cli_roundtrip(tmp_path):
