@@ -126,6 +126,26 @@ def validate_long_opts(opts: dict) -> bool:
     return True
 
 
+def tp_mesh(spec: str):
+    """Per-sample TP mesh from a ``1xM`` spec.
+
+    The reference's flagship distributed mode is ``mpirun -np X`` with
+    every layer row-split across all X ranks (ref: src/ann.c:912-936;
+    README note src/libhpnn.c:194) — no data axis.  ``--mesh 1xM``
+    without ``--batch`` is that mode on M devices; a data axis > 1 only
+    makes sense with ``--batch``.
+    """
+    from hpnn_tpu.parallel import mesh as mesh_mod
+
+    d, m = (int(v) for v in spec.lower().split("x"))
+    if d != 1:
+        raise ValueError(
+            f"per-sample training shards the model axis only (want 1xM, "
+            f"got {spec}); use --batch for data parallelism"
+        )
+    return mesh_mod.make_mesh(n_data=1, n_model=m)
+
+
 def parse_args(argv: list[str], prog: str) -> str | None:
     """Apply flags to the runtime; return the conf filename or None.
 

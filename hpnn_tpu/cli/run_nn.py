@@ -17,11 +17,23 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
-        argv, flags=("batch",), valued=("profile",)
+        argv, flags=("batch",), valued=("mesh", "profile")
     )
-    if argv is None:
+    if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
         return -1
+    tp_mesh = None
+    if "mesh" in opts:
+        if opts.get("batch"):
+            sys.stderr.write("syntax error: --mesh and --batch are exclusive!\n")
+            runtime.deinit_all()
+            return -1
+        try:
+            tp_mesh = common.tp_mesh(opts["mesh"])
+        except ValueError as exc:
+            sys.stderr.write(f"syntax error: bad --mesh: {exc}\n")
+            runtime.deinit_all()
+            return -1
     filename = common.parse_args(argv, "run_nn")
     if filename is None:
         runtime.deinit_all()
@@ -37,7 +49,7 @@ def main(argv: list[str] | None = None) -> int:
 
             batch_mod.run_kernel_batched(conf)
         else:
-            driver.run_kernel(conf)
+            driver.run_kernel(conf, mesh=tp_mesh)
     runtime.deinit_all()
     return 0
 

@@ -23,10 +23,19 @@ def main(argv: list[str] | None = None) -> int:
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
         return -1
-    if "batch" not in opts and ("epochs" in opts or "mesh" in opts):
-        sys.stderr.write("syntax error: --epochs/--mesh require --batch!\n")
+    if "batch" not in opts and "epochs" in opts:
+        sys.stderr.write("syntax error: --epochs requires --batch!\n")
         runtime.deinit_all()
         return -1
+    tp_mesh = None
+    if "mesh" in opts and "batch" not in opts:
+        # per-sample TP: the reference's `mpirun -np X train_nn` mode
+        try:
+            tp_mesh = common.tp_mesh(opts["mesh"])
+        except ValueError as exc:
+            sys.stderr.write(f"syntax error: bad --mesh: {exc}\n")
+            runtime.deinit_all()
+            return -1
     filename = common.parse_args(argv, "train_nn")
     if filename is None:
         runtime.deinit_all()
@@ -54,7 +63,7 @@ def main(argv: list[str] | None = None) -> int:
                 mesh_spec=opts.get("mesh"),
             )
         else:
-            ok = driver.train_kernel(conf)
+            ok = driver.train_kernel(conf, mesh=tp_mesh)
     if not ok:
         sys.stderr.write("FAILED to train kernel!\n")
         runtime.deinit_all()

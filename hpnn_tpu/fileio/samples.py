@@ -22,8 +22,10 @@ import numpy as np
 
 # strtod: optional whitespace then a decimal number ("inf"/"nan"/hex
 # floats parse in C but are never written by any converter — out of
-# scope, same note as round 1)
-_STRTOD = re.compile(r"\s*([-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?)")
+# scope, same note as round 1).  Bytes pattern: the walk must classify
+# RAW BYTES exactly like the C side (UTF-8 continuation bytes are
+# non-graph -> blank), so the fallback runs over line.encode().
+_STRTOD = re.compile(rb"[ \t\n\r\f\v]*([-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?)")
 
 # Guard against absurd declared counts ([input] 999999999): the
 # reference ALLOCs exactly that many doubles (exit(-1) on failure) and
@@ -90,22 +92,23 @@ def parse_row(line: str, n: int) -> np.ndarray | None:
     if row is not None:
         out[: row.size] = row
         return out
-    # pure-Python fallback: the same walk
-    pos, limit = 0, len(line)
+    # pure-Python fallback: the same walk, over the same raw bytes
+    raw = line.encode() if isinstance(line, str) else line
+    pos, limit = 0, len(raw)
     for k in range(n):
         if pos > limit:
             break  # past the "NUL": remaining values stay 0.0
-        m = _STRTOD.match(line, pos)
+        m = _STRTOD.match(raw, pos)
         if m:
             out[k] = float(m.group(1))
             pos = m.end() + 1
         else:
             pos += 1  # strtod failure: end == start, ptr = end+1
-        # SKIP_BLANK: non-graph chars except newline (common.h:250-251)
+        # SKIP_BLANK: non-graph bytes except newline (common.h:250-251)
         while (
             pos < limit
-            and line[pos] != "\n"
-            and (line[pos].isspace() or not line[pos].isprintable())
+            and raw[pos] != 0x0A
+            and not (0x20 < raw[pos] < 0x7F)
         ):
             pos += 1
     return out

@@ -169,6 +169,43 @@ def init_tpu() -> bool:
     return True
 
 
+def _honor_platform_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment.
+
+    Accelerator site hooks may select their platform programmatically
+    at interpreter startup (``jax.config`` beats the env var), which
+    silently defeats the documented ``JAX_PLATFORMS=cpu`` parity-mode
+    switch.  Applying the env value through the config restores the
+    semantics jax documents.  No-op when the env var is unset."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        # config.update is a no-op for backend selection once backends
+        # exist — detect that and say so instead of silently honoring
+        # the override this function is meant to undo.
+        already = False
+        try:
+            from jax._src import xla_bridge
+
+            already = xla_bridge.backends_are_initialized()
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", plat)
+        if already and jax.default_backend() not in plat.lower().split(","):
+            log.nn_warn(
+                sys.stderr,
+                "JAX_PLATFORMS=%s ignored: backends already initialized "
+                "on '%s'\n",
+                plat,
+                jax.default_backend(),
+            )
+    except Exception as exc:
+        log.nn_warn(sys.stderr, "JAX_PLATFORMS=%s not applied: %s\n", plat, exc)
+
+
 def init_all(init_verbose: int = 0) -> int:
     """``_NN(init,all)`` equivalent (ref: src/libhpnn.c:326-347).
 
@@ -181,6 +218,7 @@ def init_all(init_verbose: int = 0) -> int:
     init_runtime()
     if init_verbose:
         set_verbose(init_verbose)
+    _honor_platform_env()
     init_dist()
     init_threads()
     init_tpu()

@@ -58,8 +58,16 @@ def _shuffled_files(directory: str, seed: int):
         yield flist[idx]
 
 
-def train_kernel(conf: NNConf) -> bool:
-    """Train every sample in ``conf.samples`` once (one 'round')."""
+def train_kernel(conf: NNConf, mesh=None) -> bool:
+    """Train every sample in ``conf.samples`` once (one 'round').
+
+    With ``mesh`` (model-axis size > 1) the per-sample convergence loop
+    runs tensor-parallel over the mesh — the TPU-native equivalent of
+    the reference's flagship ``mpirun -np X train_nn`` row-split mode
+    (ref: src/ann.c:912-936; usage note src/libhpnn.c:194).  Token
+    stream and resulting weights are identical to the single-device
+    path (zero-padding to mesh multiples is a fixed point of the math,
+    parallel/mesh.py)."""
     import jax.numpy as jnp
 
     if conf.kernel is None or conf.samples is None or conf.type == NNType.UKN:
@@ -82,10 +90,32 @@ def train_kernel(conf: NNConf) -> bool:
         delta = loop.DELTA_BP
     alpha = 0.2  # ref: src/libhpnn.c:1248 — BPM always called with .2
 
-    weights = tuple(jnp.asarray(np.asarray(w), dtype=dtype) for w in conf.kernel.weights)
+    weights_np = [np.asarray(w, dtype=dtype) for w in conf.kernel.weights]
+    tp_state = _make_tp_state(
+        mesh, weights_np,
+        model=model, momentum=momentum,
+        min_iter=min_iter, max_iter=max_iter,
+        alpha=alpha, delta=delta,
+    )
+    if tp_state is not None:
+        weights, dw0, train_one = tp_state
+    else:
+        weights = tuple(jnp.asarray(w) for w in weights_np)
+        dw0 = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+
+        def train_one(w, m, x_np, t_np):
+            return loop.train_sample(
+                w, m,
+                jnp.asarray(x_np, dtype=dtype),
+                jnp.asarray(t_np, dtype=dtype),
+                alpha, delta,
+                model=model, momentum=momentum,
+                min_iter=min_iter, max_iter=max_iter,
+            )
+
     # momentum arrays live for the whole round (ann_momentum_init) and
     # are zeroed per sample (ann_raz_momentum inside train_BPM).
-    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    dw = dw0
 
     if conf.seed == 0:
         conf.seed = int(time.time())
@@ -95,26 +125,80 @@ def train_kernel(conf: NNConf) -> bool:
         if sample is None:
             continue
         tr_in, tr_out = sample
-        x = jnp.asarray(tr_in, dtype=dtype)
-        t = jnp.asarray(tr_out, dtype=dtype)
         if momentum:
-            dw = tuple(jnp.zeros_like(w) for w in weights)  # raz_momentum
-        res = loop.train_sample(
-            weights,
-            dw,
-            x,
-            t,
-            alpha,
-            delta,
-            model=model,
-            momentum=momentum,
-            min_iter=min_iter,
-            max_iter=max_iter,
-        )
+            dw = dw0  # raz_momentum: fresh zeros each sample
+        res = train_one(weights, dw, tr_in, tr_out)
         weights, dw = res.weights, res.dw
         _print_train_tokens(res, model, momentum)
-    conf.kernel = kernel_mod.Kernel(tuple(np.asarray(w) for w in weights))
+    if tp_state is not None:
+        from hpnn_tpu.parallel import mesh as mesh_mod
+
+        orig_rows = [w.shape[0] for w in weights_np]
+        conf.kernel = kernel_mod.Kernel(
+            mesh_mod.unpad_kernel([np.asarray(w) for w in weights], orig_rows)
+        )
+    else:
+        conf.kernel = kernel_mod.Kernel(tuple(np.asarray(w) for w in weights))
     return True
+
+
+def _tp_shard(mesh, weights_np):
+    """Pad layer rows to mesh multiples and shard them on the model
+    axis — the common setup of the TP train and eval paths.  Returns
+    (sharded_weights, padded_np) or None when no model-axis sharding is
+    requested.  ``weights_np`` must already carry the compute dtype
+    (``pad_kernel`` preserves it)."""
+    from hpnn_tpu.parallel import mesh as mesh_mod
+
+    if mesh is None or mesh.shape[mesh_mod.MODEL_AXIS] < 2:
+        return None
+    from hpnn_tpu.parallel import tp
+
+    k = mesh.shape[mesh_mod.MODEL_AXIS]
+    padded, _ = mesh_mod.pad_kernel(weights_np, k)
+    return tp.shard_kernel(padded, mesh), padded
+
+
+def _make_tp_state(
+    mesh, weights_np, *, model, momentum, min_iter, max_iter, alpha, delta
+):
+    """Sharded weights + per-sample TP trainer closure, or None when no
+    model-axis sharding is requested."""
+    sharded = _tp_shard(mesh, weights_np)
+    if sharded is None:
+        return None
+    import jax.numpy as jnp
+
+    from hpnn_tpu.parallel import tp
+
+    weights, padded = sharded
+    dtype = padded[0].dtype
+    n_out = weights_np[-1].shape[0]
+    dw0 = (
+        tp.shard_kernel(tuple(np.zeros_like(p) for p in padded), mesh)
+        if momentum
+        else ()
+    )
+    fn = tp.make_train_fn(
+        mesh, len(padded),
+        model=model, momentum=momentum,
+        min_iter=min_iter, max_iter=max_iter, n_out=n_out,
+    )
+    pad_out = padded[-1].shape[0]
+    alpha_j = jnp.asarray(alpha, dtype=dtype)
+    delta_j = jnp.asarray(delta, dtype=dtype)
+
+    def train_one(w, m, x_np, t_np):
+        t_pad = np.zeros(pad_out, dtype=dtype)
+        t_pad[: t_np.shape[0]] = t_np
+        return fn(
+            w, m,
+            tp.replicate(jnp.asarray(x_np, dtype=dtype), mesh),
+            tp.replicate(jnp.asarray(t_pad), mesh),
+            alpha_j, delta_j,
+        )
+
+    return weights, dw0, train_one
 
 
 def _print_train_tokens(res, model: str, momentum: bool) -> None:
@@ -130,8 +214,12 @@ def _print_train_tokens(res, model: str, momentum: bool) -> None:
     log.flush()
 
 
-def run_kernel(conf: NNConf) -> None:
-    """Evaluate every sample in ``conf.tests`` (argmax vs target)."""
+def run_kernel(conf: NNConf, mesh=None) -> None:
+    """Evaluate every sample in ``conf.tests`` (argmax vs target).
+
+    With ``mesh``, the forward pass runs tensor-parallel (row-sharded
+    layers, ref MPI eval: src/ann.c:912-936); verdict tokens are
+    computed on the real (unpadded) outputs and are identical."""
     import jax.numpy as jnp
 
     if conf.kernel is None or conf.tests is None or conf.type == NNType.UKN:
@@ -141,7 +229,28 @@ def run_kernel(conf: NNConf) -> None:
         return
     dtype = _compute_dtype()
     model = "snn" if conf.type in (NNType.SNN, NNType.LNN) else "ann"
-    weights = tuple(jnp.asarray(np.asarray(w), dtype=dtype) for w in conf.kernel.weights)
+    weights_np = [np.asarray(w, dtype=dtype) for w in conf.kernel.weights]
+    n_out = weights_np[-1].shape[0]
+
+    sharded = _tp_shard(mesh, weights_np)
+    if sharded is not None:
+        from hpnn_tpu.parallel import tp
+
+        w_sh, padded = sharded
+        run_fn = tp.make_run_fn(mesh, len(padded), model=model, n_out=n_out)
+
+        def forward(x_np):
+            x = tp.replicate(jnp.asarray(x_np, dtype=dtype), mesh)
+            return np.asarray(run_fn(w_sh, x))[:n_out]
+    else:
+        weights = tuple(jnp.asarray(w) for w in weights_np)
+
+        def forward(x_np):
+            return np.asarray(
+                loop.run_sample(
+                    weights, jnp.asarray(x_np, dtype=dtype), model=model
+                )
+            )
 
     if conf.seed == 0:
         conf.seed = int(time.time())
@@ -151,10 +260,7 @@ def run_kernel(conf: NNConf) -> None:
         if sample is None:
             continue
         tr_in, tr_out = sample
-        out = np.asarray(
-            loop.run_sample(weights, jnp.asarray(tr_in, dtype=dtype), model=model)
-        )
-        print_verdict(out, tr_out, model)
+        print_verdict(forward(tr_in), tr_out, model)
         log.flush()
 
 

@@ -102,3 +102,53 @@ def test_train_reproducible(workdir, capsys):
     assert train_nn.main([conf]) == 0
     k2 = open("kernel.opt").read()
     assert k1 == k2
+
+
+@pytest.mark.parametrize(
+    "typ,train", [("ANN", "BP"), ("ANN", "BPM"), ("SNN", "BP"), ("SNN", "BPM")]
+)
+def test_tp_cli_matches_single_device(workdir, capsys, typ, train):
+    """`--mesh 1x4` (the reference's mpirun row-split mode, ref:
+    src/ann.c:912-936) must produce the SAME token stream and the same
+    kernel.opt weights as the single-device per-sample driver."""
+    conf = _conf(workdir, typ=typ, train=train)
+    assert train_nn.main(["-v", "-v", conf]) == 0
+    out_single = capsys.readouterr().out
+    k_single = open("kernel.opt").read()
+
+    assert train_nn.main(["-v", "-v", "--mesh", "1x4", conf]) == 0
+    out_tp = capsys.readouterr().out
+    k_tp = open("kernel.opt").read()
+
+    assert out_tp == out_single
+    w_s = _rows(k_single)
+    w_t = _rows(k_tp)
+    assert len(w_s) == len(w_t)
+    for (_, a), (_, b) in zip(w_s, w_t):
+        np.testing.assert_allclose(b, a, atol=1e-10)
+
+    # eval parity: --mesh forward pass prints identical verdicts
+    cont = workdir / "cont.conf"
+    cont.write_text(
+        open(conf).read().replace("[init] generate", "[init] kernel.opt")
+    )
+    assert run_nn.main(["-v", "-v", str(cont)]) == 0
+    ev_single = capsys.readouterr().out
+    assert run_nn.main(["-v", "-v", "--mesh", "1x4", str(cont)]) == 0
+    ev_tp = capsys.readouterr().out
+    assert ev_tp == ev_single
+    assert "[PASS]" in ev_single
+
+
+def _rows(kernel_text):
+    """(line_no, weight_row) pairs from kernel-format text."""
+    out = []
+    for i, line in enumerate(kernel_text.splitlines()):
+        if line and not line.startswith("["):
+            out.append((i, np.fromstring(line, sep=" ")))
+    return out
+
+
+def test_tp_cli_rejects_data_axis(workdir, capsys):
+    conf = _conf(workdir)
+    assert train_nn.main(["--mesh", "2x2", conf]) == -1

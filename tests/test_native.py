@@ -82,6 +82,7 @@ def test_parse_row_matches_python_walk(monkeypatch):
         "only 2 number-ish 4x",
         "xxxxx 1.0",  # junk-heavy: each junk char consumes a slot
         "!!!!!!!!!! 9",  # more junk chars than len//2 slots
+        "1.0 \u00e9 2.0",  # non-ASCII: UTF-8 bytes are non-graph -> blank
     ]
     assert native.lib() is not None  # else this compares fallback to itself
     natives = [parse_row(line, 8) for line in lines]
