@@ -155,6 +155,13 @@ def train_kernel_batched(
         tuple(np.zeros_like(np.asarray(w)) for w in weights), mesh
     ) if momentum else ()
 
+    from hpnn_tpu.utils import debug
+
+    debug.alloc_report(
+        [np.asarray(w) for w in conf.kernel.weights],
+        tuple(w_sh) + tuple(dw_sh),
+    )
+
     Xd = X.astype(dtype)
     Td = T.astype(dtype)
     if conf.seed == 0:  # 0 means "random", like the reference's srandom
@@ -163,11 +170,20 @@ def train_kernel_batched(
         conf.seed = int(time.time())
     rng = np.random.RandomState(conf.seed & 0x7FFFFFFF)
     loss = float("nan")
+    pad = (-n) % B
+    if pad:
+        # no silent caps: the tail wrap re-trains `pad` sample slots
+        # per epoch so every jitted batch keeps its static shape
+        log.nn_warn(
+            sys.stdout,
+            "batch wrap: %i duplicate sample slots per epoch "
+            "(n=%i, batch=%i)\n",
+            pad, n, B,
+        )
     for epoch in range(1, epochs + 1):
         order = rng.permutation(n)
         # wrap the tail so every batch is full (static shapes for jit);
         # np.resize repeats the permutation as needed even when B > 2n
-        pad = (-n) % B
         if pad:
             order = np.resize(order, n + pad)
         losses = []
@@ -197,8 +213,12 @@ def train_kernel_batched(
 
 
 def run_kernel_batched(conf: NNConf) -> None:
-    """Vectorized eval over ``conf.tests``; same tokens as the
-    per-sample driver, printed in readdir order."""
+    """Vectorized eval over ``conf.tests``: one vmapped forward pass,
+    then the per-sample token protocol printed in the SAME seeded
+    shuffle order as the per-sample driver (ref: src/libhpnn.c:
+    1218-1229) — the stream is drop-in comparable for the same seed.
+    Unreadable/malformed files print their TESTING FILE header with no
+    verdict, exactly like the per-sample path."""
     import jax.numpy as jnp
 
     if conf.kernel is None or conf.tests is None or conf.type == NNType.UKN:
@@ -218,8 +238,19 @@ def run_kernel_batched(conf: NNConf) -> None:
     out = np.asarray(eval_fn(weights, jnp.asarray(X.astype(dtype))))
 
     from hpnn_tpu.train.driver import print_verdict
+    from hpnn_tpu.utils.glibc_random import shuffled_order
 
-    for i, name in enumerate(names):
+    if conf.seed == 0:  # 0 means "time-seeded", like the reference
+        import time
+
+        conf.seed = int(time.time())
+    row_of = {name: i for i, name in enumerate(names)}
+    all_files = sample_io.list_sample_files(conf.tests)
+    for idx in shuffled_order(conf.seed, len(all_files)):
+        name = all_files[idx]
         log.nn_out(sys.stdout, "TESTING FILE: %16.16s\t", name)
+        i = row_of.get(name)
+        if i is None:  # unreadable/malformed: header only, no verdict
+            continue
         print_verdict(out[i], T[i], model)
     log.flush()

@@ -113,6 +113,12 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 min_iter=min_iter, max_iter=max_iter,
             )
 
+    # ALLOC_REPORT parity: host + device byte accounting at -vv/-vvv
+    # (ref: common.h:245-248; report site src/ann.c:190-200)
+    from hpnn_tpu.utils import debug
+
+    debug.alloc_report(weights_np, tuple(weights) + tuple(dw0))
+
     # momentum arrays live for the whole round (ann_momentum_init) and
     # are zeroed per sample (ann_raz_momentum inside train_BPM).
     dw = dw0
@@ -244,6 +250,7 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
             return np.asarray(run_fn(w_sh, x))[:n_out]
     else:
         weights = tuple(jnp.asarray(w) for w in weights_np)
+        w_sh = weights
 
         def forward(x_np):
             return np.asarray(
@@ -251,6 +258,10 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
                     weights, jnp.asarray(x_np, dtype=dtype), model=model
                 )
             )
+
+    from hpnn_tpu.utils import debug
+
+    debug.alloc_report(weights_np, tuple(w_sh))
 
     if conf.seed == 0:
         conf.seed = int(time.time())

@@ -1,0 +1,84 @@
+"""Numerical-trace and memory-accounting aids.
+
+The reference debugs its 1e-12 cross-backend consistency bar with two
+tools (SURVEY.md §5):
+
+* ``DBG_TRACE(array,N)`` — plain sum of an array printed as
+  ``#DBG: acc=%.15f`` (ref: /root/reference/include/libhpnn/ann.h:29-33;
+  the CUDA twin ``CUDA_TRACE_V`` does ``cublasDasum``,
+  common.h:486-490);
+* ``ALLOC_REPORT`` byte accounting accumulated per allocation and
+  reported as ``[CPU]/[GPU] ANN total allocation: N (bytes)`` at
+  ``NN_OUT`` level (ref: common.h:245-248; report site src/ann.c:
+  190-200).
+
+Here the kernel lives twice — a host numpy copy and device (HBM)
+arrays, possibly sharded — so the report mirrors the reference's
+CPU/GPU pairing with the device platform as the second tag.  Device
+``nbytes`` is the logical array size; XLA's HBM padding/layout overhead
+is not visible from the host and is not counted.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from hpnn_tpu.utils import logging as log
+
+
+def dbg_trace(array, fp=None) -> float:
+    """``DBG_TRACE`` equivalent: plain (signed) sum, printed at debug
+    verbosity as ``#DBG: acc=%.15f``.  Returns the sum so tests and
+    debugging sessions can assert on it without capturing stdout."""
+    acc = float(np.sum(np.asarray(array)))
+    log.nn_dbg(fp or sys.stdout, "#DBG: acc=%.15f\n", acc)
+    return acc
+
+
+def trace_kernel(weights, fp=None) -> tuple:
+    """``DBG_TRACE`` over every layer of a kernel, in layer order —
+    the way the reference sprinkles it through ann.c to localize a
+    diverging backend."""
+    return tuple(dbg_trace(w, fp) for w in weights)
+
+
+def alloc_report(host_weights, device_arrays=(), fp=None) -> int:
+    """``ALLOC_REPORT`` equivalent for a kernel's two residencies.
+
+    Prints per-layer byte counts at ``NN_DBG`` (-vvv) and the
+    reference's total line(s) at ``NN_OUT``:
+
+        NN: [CPU] ANN total allocation: N (bytes)
+        NN: [TPU] ANN total allocation: N (bytes)   <- device line only
+                                                       off-host
+
+    Returns the total host byte count.
+    """
+    fp = fp or sys.stdout
+    total = 0
+    for i, w in enumerate(host_weights):
+        n = np.asarray(w).nbytes
+        total += n
+        log.nn_dbg(fp, "[CPU] layer %i allocation: %i (bytes)\n", i + 1, n)
+    log.nn_out(fp, "[CPU] ANN total allocation: %i (bytes)\n", total)
+    dev_total = 0
+    platform = None
+    for w in device_arrays:
+        try:
+            devs = list(w.devices())
+        except Exception:
+            continue
+        if not devs:
+            continue
+        platform = platform or devs[0].platform
+        dev_total += w.nbytes
+    if platform and platform != "cpu":
+        log.nn_out(
+            fp,
+            "[%s] ANN total allocation: %i (bytes)\n",
+            platform.upper(),
+            dev_total,
+        )
+    return total

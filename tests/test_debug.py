@@ -1,0 +1,60 @@
+"""DBG_TRACE / ALLOC_REPORT parity aids (utils/debug.py).
+
+Reference: DBG_TRACE prints '#DBG: acc=%.15f' (include/libhpnn/ann.h:
+29-33); ALLOC_REPORT accumulates bytes and ann_kernel_allocate reports
+'[CPU] ANN total allocation: %lu (bytes)' at NN_OUT (src/ann.c:190-200,
+common.h:245-248).
+"""
+
+import numpy as np
+
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.utils import debug, logging as log
+
+
+def test_dbg_trace_token(capsys):
+    log.set_verbose(3)
+    arr = np.array([1.5, -0.25, 2.0])
+    acc = debug.dbg_trace(arr)
+    assert acc == 3.25
+    out = capsys.readouterr().out
+    assert "NN(DBG): #DBG: acc=3.250000000000000\n" in out
+    # silent below debug verbosity, value still returned
+    log.set_verbose(2)
+    assert debug.dbg_trace(arr) == 3.25
+    assert capsys.readouterr().out == ""
+
+
+def test_trace_kernel_layer_order(capsys):
+    log.set_verbose(3)
+    k, _ = kernel_mod.generate(3, 4, [3], 2)
+    accs = debug.trace_kernel(k.weights)
+    assert accs == tuple(float(np.sum(w)) for w in k.weights)
+    assert capsys.readouterr().out.count("#DBG: acc=") == 2
+
+
+def test_alloc_report_tokens(capsys):
+    import jax.numpy as jnp
+
+    log.set_verbose(3)
+    k, _ = kernel_mod.generate(3, 4, [3], 2)
+    dev = tuple(jnp.asarray(w) for w in k.weights)
+    total = debug.alloc_report(k.weights, dev)
+    assert total == sum(w.nbytes for w in k.weights)
+    out = capsys.readouterr().out
+    assert f"NN: [CPU] ANN total allocation: {total} (bytes)\n" in out
+    assert "NN(DBG): [CPU] layer 1 allocation:" in out
+    # CPU devices: no accelerator line
+    assert out.count("ANN total allocation") == 1
+
+
+def test_alloc_report_in_driver(tmp_path, capsys):
+    """-vv training prints the allocation line (ref: src/ann.c:197)."""
+    from tests.test_batch import _conf
+    from hpnn_tpu.train import driver
+
+    log.set_verbose(2)
+    conf = _conf(tmp_path, n=2)
+    assert driver.train_kernel(conf)
+    out = capsys.readouterr().out
+    assert "NN: [CPU] ANN total allocation:" in out
