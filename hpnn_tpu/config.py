@@ -235,19 +235,36 @@ def dump_conf(conf: NNConf, fp) -> None:
 
 
 # ------------------------------------------------- type-dispatch (C4)
+def _report_kernel_alloc(conf: NNConf) -> None:
+    """ALLOC_REPORT at the reference's site: ``ann_kernel_allocate``
+    prints '[CPU] ANN total allocation' at -vv during kernel
+    generate/load (ref: src/ann.c:197) — never from the train/run
+    drivers (``_NN(run,kernel)`` allocates no kernel,
+    src/libhpnn.c:1306-1536)."""
+    from hpnn_tpu.utils import debug
+
+    debug.alloc_report(conf.kernel.weights)
+
+
 def generate_kernel(conf: NNConf, n_in: int, hiddens: list[int], n_out: int) -> bool:
-    """``_NN(generate,kernel)`` — ANN/SNN share the same generator."""
-    if conf.type not in (NNType.ANN, NNType.SNN, NNType.LNN):
+    """``_NN(generate,kernel)`` — ANN/SNN share the same generator; LNN
+    is declared but refused, so an LNN conf can never obtain a kernel
+    (ref: src/libhpnn.c:975-980)."""
+    if conf.type not in (NNType.ANN, NNType.SNN):
         return False
     k, seed = kernel_mod.generate(conf.seed, n_in, hiddens, n_out)
     conf.seed = seed
     conf.kernel = k
     conf.kernel_name = None  # generated kernels are unnamed (ref parity)
+    _report_kernel_alloc(conf)
     return True
 
 
 def load_kernel(conf: NNConf) -> bool:
     if conf.f_kernel is None:
+        return False
+    if conf.type not in (NNType.ANN, NNType.SNN):
+        # LNN/UKN arms return FALSE (ref: src/libhpnn.c:992-995)
         return False
     try:
         name, k = kernel_mod.load(conf.f_kernel)
@@ -261,6 +278,7 @@ def load_kernel(conf: NNConf) -> bool:
     # substitutes "noname" only for a NULL strdup (zero-length source,
     # ref: src/ann.c:268-269), not for an empty parsed name
     conf.kernel_name = name
+    _report_kernel_alloc(conf)
     return True
 
 

@@ -95,6 +95,10 @@ def parse_row(line: str, n: int) -> np.ndarray | None:
     # pure-Python fallback: the same walk, over the same raw bytes
     raw = line.encode() if isinstance(line, str) else line
     pos, limit = 0, len(raw)
+    # SKIP_BLANK runs once BEFORE the first GET_DOUBLE (ref:
+    # src/ann.c:438, src/libhpnn.c:1104): leading non-graph
+    # non-whitespace bytes (0x01, 0x7F, ...) must not cost slot 0.
+    pos = _skip_blank(raw, pos, limit)
     for k in range(n):
         if pos > limit:
             break  # past the "NUL": remaining values stay 0.0
@@ -104,14 +108,16 @@ def parse_row(line: str, n: int) -> np.ndarray | None:
             pos = m.end() + 1
         else:
             pos += 1  # strtod failure: end == start, ptr = end+1
-        # SKIP_BLANK: non-graph bytes except newline (common.h:250-251)
-        while (
-            pos < limit
-            and raw[pos] != 0x0A
-            and not (0x20 < raw[pos] < 0x7F)
-        ):
-            pos += 1
+        pos = _skip_blank(raw, pos, limit)
     return out
+
+
+def _skip_blank(raw: bytes, pos: int, limit: int) -> int:
+    """SKIP_BLANK: advance over non-graph bytes except newline
+    (common.h:250-251)."""
+    while pos < limit and raw[pos] != 0x0A and not (0x20 < raw[pos] < 0x7F):
+        pos += 1
+    return pos
 
 
 def read_dir(directory: str):

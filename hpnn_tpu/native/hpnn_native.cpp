@@ -127,6 +127,11 @@ int64_t parse_doubles(const char* buf, int64_t maxn, double* out) {
   const char* p = buf;
   char* end;
   int64_t count = 0;
+  // SKIP_BLANK runs once BEFORE the first GET_DOUBLE (ref:
+  // src/ann.c:438, src/libhpnn.c:1104): leading non-graph bytes that
+  // are not C whitespace (0x01, 0x7F, high bytes) must not make
+  // strtod fail the first slot.
+  while (p < lim && *p != '\n' && !(*p > ' ' && *p < 0x7f)) ++p;
   while (count < maxn && p <= lim) {
     double v = strtod(p, &end);
     out[count++] = (end == p) ? 0.0 : v;

@@ -157,10 +157,7 @@ def train_kernel_batched(
 
     from hpnn_tpu.utils import debug
 
-    debug.alloc_report(
-        [np.asarray(w) for w in conf.kernel.weights],
-        tuple(w_sh) + tuple(dw_sh),
-    )
+    debug.device_alloc_report(tuple(w_sh) + tuple(dw_sh))
 
     Xd = X.astype(dtype)
     Td = T.astype(dtype)
@@ -173,9 +170,11 @@ def train_kernel_batched(
     pad = (-n) % B
     if pad:
         # no silent caps: the tail wrap re-trains `pad` sample slots
-        # per epoch so every jitted batch keeps its static shape
+        # per epoch so every jitted batch keeps its static shape.
+        # stderr, like every other warning — stdout is the grep-able
+        # metrics token stream (SURVEY.md §5)
         log.nn_warn(
-            sys.stdout,
+            sys.stderr,
             "batch wrap: %i duplicate sample slots per epoch "
             "(n=%i, batch=%i)\n",
             pad, n, B,
@@ -235,6 +234,10 @@ def run_kernel_batched(conf: NNConf) -> None:
         jnp.asarray(np.asarray(w), dtype=dtype) for w in conf.kernel.weights
     )
     eval_fn = make_eval_fn(model=model)
+
+    from hpnn_tpu.utils import debug
+
+    debug.device_alloc_report(weights)
     out = np.asarray(eval_fn(weights, jnp.asarray(X.astype(dtype))))
 
     from hpnn_tpu.train.driver import print_verdict

@@ -113,11 +113,12 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 min_iter=min_iter, max_iter=max_iter,
             )
 
-    # ALLOC_REPORT parity: host + device byte accounting at -vv/-vvv
-    # (ref: common.h:245-248; report site src/ann.c:190-200)
+    # device half of ALLOC_REPORT once arrays are placed (the host line
+    # printed at kernel generate/load — config._report_kernel_alloc);
+    # per-chip bytes, ref twin: scuda_ann_allocate (src/ann.c:199)
     from hpnn_tpu.utils import debug
 
-    debug.alloc_report(weights_np, tuple(weights) + tuple(dw0))
+    debug.device_alloc_report(tuple(weights) + tuple(dw0))
 
     # momentum arrays live for the whole round (ann_momentum_init) and
     # are zeroed per sample (ann_raz_momentum inside train_BPM).
@@ -261,7 +262,7 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
 
     from hpnn_tpu.utils import debug
 
-    debug.alloc_report(weights_np, tuple(w_sh))
+    debug.device_alloc_report(tuple(w_sh))
 
     if conf.seed == 0:
         conf.seed = int(time.time())

@@ -13,9 +13,16 @@ tools (SURVEY.md §5):
   190-200).
 
 Here the kernel lives twice — a host numpy copy and device (HBM)
-arrays, possibly sharded — so the report mirrors the reference's
-CPU/GPU pairing with the device platform as the second tag.  Device
-``nbytes`` is the logical array size; XLA's HBM padding/layout overhead
+arrays, possibly sharded.  The host line prints where the reference
+prints it: at kernel allocation time (``ann_kernel_allocate`` is called
+from ``ann_load``/``ann_generate`` during conf load — never from the
+train/run drivers), via ``alloc_report`` in config.py's kernel
+generate/load.  The device line (``device_alloc_report``) prints from
+the drivers once arrays are placed, mirroring the reference's
+``[GPU] ANN total allocation`` twin from ``scuda_ann_allocate``
+(cuda_ann.cu:225-237); its byte count is **per-chip residency** (sum of
+this chip's shards), matching the reference's per-process GPU bytes —
+not the global logical array size.  XLA's HBM padding/layout overhead
 is not visible from the host and is not counted.
 """
 
@@ -63,22 +70,41 @@ def alloc_report(host_weights, device_arrays=(), fp=None) -> int:
         total += n
         log.nn_dbg(fp, "[CPU] layer %i allocation: %i (bytes)\n", i + 1, n)
     log.nn_out(fp, "[CPU] ANN total allocation: %i (bytes)\n", total)
-    dev_total = 0
-    platform = None
+    if device_arrays:
+        device_alloc_report(device_arrays, fp)
+    return total
+
+
+def device_alloc_report(device_arrays, fp=None) -> int:
+    """The device half of ``ALLOC_REPORT`` — the reference's ``[GPU] ANN
+    total allocation`` line (ref: src/ann.c:199; bytes accumulated in
+    scuda_ann_allocate, cuda_ann.cu:225-237).
+
+    Bytes are **per-chip residency**: each chip's shard bytes are summed
+    and the largest per-chip total is reported, so a model-axis-sharded
+    kernel reports HBM actually held per chip, not the global logical
+    size.  Prints nothing when the arrays live on the host platform
+    (the CPU line already covers them).  Returns the reported bytes.
+    """
+    fp = fp or sys.stdout
+    by_dev: dict = {}
     for w in device_arrays:
         try:
-            devs = list(w.devices())
+            shards = list(w.addressable_shards)
         except Exception:
             continue
-        if not devs:
-            continue
-        platform = platform or devs[0].platform
-        dev_total += w.nbytes
-    if platform and platform != "cpu":
-        log.nn_out(
-            fp,
-            "[%s] ANN total allocation: %i (bytes)\n",
-            platform.upper(),
-            dev_total,
-        )
-    return total
+        for s in shards:
+            by_dev[s.device] = by_dev.get(s.device, 0) + s.data.nbytes
+    if not by_dev:
+        return 0
+    platform = next(iter(by_dev)).platform
+    if platform == "cpu":
+        return 0
+    dev_total = max(by_dev.values())
+    log.nn_out(
+        fp,
+        "[%s] ANN total allocation: %i (bytes)\n",
+        platform.upper(),
+        dev_total,
+    )
+    return dev_total

@@ -96,15 +96,17 @@ def test_batch_wrap_warns(tmp_path, capsys):
     log.set_verbose(1)
     conf = _conf(tmp_path, n=10)
     assert batch_mod.train_kernel_batched(conf, batch_size=8, epochs=1)
-    err = capsys.readouterr().out
-    assert "batch wrap: 6 duplicate sample slots per epoch" in err
+    captured = capsys.readouterr()
+    # warnings go to stderr — stdout is the metrics token stream
+    assert "batch wrap: 6 duplicate sample slots per epoch" in captured.err
+    assert "batch wrap" not in captured.out
 
     log.set_verbose(1)
     (tmp_path / "b").mkdir()
     conf2 = _conf(tmp_path / "b", n=16)
     assert batch_mod.train_kernel_batched(conf2, batch_size=8, epochs=1)
-    err = capsys.readouterr().out
-    assert "batch wrap" not in err
+    captured = capsys.readouterr()
+    assert "batch wrap" not in captured.err + captured.out
 
 
 def test_accuracy_counts_quirks():

@@ -48,13 +48,69 @@ def test_alloc_report_tokens(capsys):
     assert out.count("ANN total allocation") == 1
 
 
-def test_alloc_report_in_driver(tmp_path, capsys):
-    """-vv training prints the allocation line (ref: src/ann.c:197)."""
-    from tests.test_batch import _conf
+def test_alloc_report_at_kernel_generate(tmp_path, capsys):
+    """-vv conf load prints the allocation line once, at the
+    reference's site — kernel allocation during generate/load
+    (ref: src/ann.c:197 via ann_generate/ann_load) — and the train/run
+    drivers add none (ref: _NN(run,kernel) allocates no kernel,
+    src/libhpnn.c:1306-1536)."""
+    from hpnn_tpu import config
     from hpnn_tpu.train import driver
 
     log.set_verbose(2)
-    conf = _conf(tmp_path, n=2)
-    assert driver.train_kernel(conf)
+    (tmp_path / "samples").mkdir()
+    from tests.test_batch import _write_samples
+
+    _write_samples(tmp_path / "samples", 2)
+    (tmp_path / "nn.conf").write_text(
+        "[name] t\n[type] ANN\n[init] generate\n[seed] 1\n"
+        "[input] 8\n[hidden] 6\n[output] 2\n[train] BP\n"
+        f"[sample_dir] {tmp_path}/samples\n[test_dir] {tmp_path}/samples\n"
+    )
+    conf = config.load_conf(str(tmp_path / "nn.conf"))
     out = capsys.readouterr().out
-    assert "NN: [CPU] ANN total allocation:" in out
+    assert out.count("NN: [CPU] ANN total allocation:") == 1
+    assert driver.train_kernel(conf)
+    driver.run_kernel(conf)
+    out = capsys.readouterr().out
+    # drivers print no HOST line (a [TPU] device line is legitimate)
+    assert "[CPU] ANN total allocation" not in out
+
+
+def test_load_kernel_reports_alloc(tmp_path, capsys):
+    """The load path reports at the same site as generate
+    (ref: ann_load -> ann_kernel_allocate -> src/ann.c:197)."""
+    from hpnn_tpu import config
+    from hpnn_tpu.config import NNConf, NNType
+
+    log.set_verbose(2)
+    k, _ = kernel_mod.generate(3, 4, [3], 2)
+    with open(tmp_path / "k.txt", "w") as fp:
+        kernel_mod.dump("t", k, fp)
+    capsys.readouterr()
+    conf = NNConf(type=NNType.ANN, f_kernel=str(tmp_path / "k.txt"))
+    assert config.load_kernel(conf)
+    out = capsys.readouterr().out
+    assert out.count("NN: [CPU] ANN total allocation:") == 1
+
+
+def test_lnn_refusal(tmp_path, capsys):
+    """LNN is declared but refused by generate/load kernel dispatch
+    (ref: src/libhpnn.c:975-980,992-995) — an LNN conf can never
+    train."""
+    from hpnn_tpu import config
+    from hpnn_tpu.config import NNConf, NNType
+
+    log.set_verbose(0)
+    conf = NNConf(type=NNType.LNN)
+    assert not config.generate_kernel(conf, 4, [3], 2)
+    assert conf.kernel is None
+    conf.f_kernel = "whatever.txt"
+    assert not config.load_kernel(conf)
+    # conf-level: an LNN [type] with [init] generate fails to load
+    (tmp_path / "nn.conf").write_text(
+        "[name] t\n[type] LNN\n[init] generate\n[seed] 1\n"
+        "[input] 4\n[hidden] 3\n[output] 2\n[train] BP\n"
+        "[sample_dir] s\n[test_dir] s\n"
+    )
+    assert config.load_conf(str(tmp_path / "nn.conf")) is None

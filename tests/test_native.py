@@ -83,12 +83,32 @@ def test_parse_row_matches_python_walk(monkeypatch):
         "xxxxx 1.0",  # junk-heavy: each junk char consumes a slot
         "!!!!!!!!!! 9",  # more junk chars than len//2 slots
         "1.0 \u00e9 2.0",  # non-ASCII: UTF-8 bytes are non-graph -> blank
+        "\x01 1.5 2.5",  # leading non-graph, non-C-whitespace byte
+        "\x7f\x01-3.5 4",  # several leading non-graph bytes
     ]
     assert native.lib() is not None  # else this compares fallback to itself
     natives = [parse_row(line, 8) for line in lines]
     monkeypatch.setenv("HPNN_NO_NATIVE", "1")
     for line, a in zip(lines, natives):
         np.testing.assert_array_equal(a, parse_row(line, 8), err_msg=repr(line))
+
+
+def test_parse_row_skip_blank_before_first(monkeypatch):
+    """SKIP_BLANK runs before the FIRST GET_DOUBLE (ref: src/ann.c:438,
+    src/libhpnn.c:1104): a row starting with a non-graph byte that is
+    not C whitespace still reads the first real number into slot 0, in
+    both the native walk and the Python fallback."""
+    from hpnn_tpu.fileio.samples import parse_row
+
+    for env in (None, "1"):
+        if env:
+            monkeypatch.setenv("HPNN_NO_NATIVE", env)
+        np.testing.assert_array_equal(
+            parse_row("\x01 1.5 2.5", 2), [1.5, 2.5]
+        )
+        np.testing.assert_array_equal(
+            parse_row("\x7f\x01-3.5 4.0", 2), [-3.5, 4.0]
+        )
 
 
 def test_no_native_env_disables(monkeypatch):
