@@ -36,8 +36,27 @@ BP_LEARN_RATE = 0.001
 BPM_LEARN_RATE = 0.0005
 
 
+@jax.custom_jvp
 def act(x):
     return 2.0 / (1.0 + jnp.exp(-x)) - 1.0
+
+
+@act.defjvp
+def _act_jvp(primals, tangents):
+    """Autodiff rule = the reference's own dact-in-terms-of-y identity.
+
+    The naive grad of ``2/(1+exp(-x))`` computes ``exp(-x)`` in the
+    backward pass, which overflows to inf (→ NaN via inf/inf) for
+    x ≲ -88 in f32 — immediately fatal on unnormalized 0-255 pixel
+    inputs (the pmnist format, ref: prepare_mnist.c:49-52) even though
+    the forward value saturates cleanly.  The reference never
+    differentiates the exp form: its backward pass uses
+    ``dact(y) = -0.5*(y²-1)`` (ref: src/ann.c:883-888), which is
+    bounded in [0, 0.5] — so the autodiff (batch DP) path uses exactly
+    that, keeping forward bit-identical and gradients finite."""
+    (x,), (dx,) = primals, tangents
+    y = act(x)
+    return y, dact(y) * dx
 
 
 def dact(y):

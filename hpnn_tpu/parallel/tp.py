@@ -225,6 +225,34 @@ def make_run_fn(mesh, n_layers: int, *, model: str = "ann", n_out: int):
     return jax.jit(sharded)
 
 
+def make_batched_run_fn(mesh, n_layers: int, *, model: str = "ann",
+                        n_out: int):
+    """Jitted TP forward over a batch: vmap of :func:`forward_local`
+    inside one ``shard_map`` — the tensor-parallel eval pays one
+    dispatch per chunk instead of one per file.  Matmul precision is
+    pinned HIGHEST so batched outputs agree with the per-sample TP
+    matvecs (see batch.make_eval_fn for why)."""
+    wspec = kernel_specs(n_layers)
+    rep = P(None, None)
+
+    def f(weights_loc, X):
+        fwd = lambda x: forward_local(
+            weights_loc, x, model=model, n_out=n_out
+        )[-1]
+        return jax.vmap(fwd)(X)
+
+    sharded = jax.shard_map(
+        f, mesh=mesh, in_specs=(wspec, rep), out_specs=rep, check_vma=False
+    )
+
+    @jax.jit
+    def g(weights, X):
+        with jax.default_matmul_precision("float32"):
+            return sharded(weights, X)
+
+    return g
+
+
 def shard_kernel(weights, mesh):
     """Place per-layer weights with rows on the model axis."""
     return tuple(

@@ -65,7 +65,13 @@ def _model_of(conf: NNConf) -> str:
 
 
 def make_eval_fn(*, model: str):
-    """Jitted vmapped forward over a batch of inputs."""
+    """Jitted vmapped forward over a batch of inputs.
+
+    Matmul precision is pinned to HIGHEST: the vmapped forward lowers
+    to MXU matmuls which default to bf16-truncated inputs on TPU,
+    while the per-sample M=1 matvec path stays full f32 on the VPU —
+    without the pin the two eval streams would disagree on near-tie
+    argmaxes and on SNN's printed probabilities."""
     import jax
 
     from hpnn_tpu.models import ann, snn
@@ -74,7 +80,8 @@ def make_eval_fn(*, model: str):
 
     @jax.jit
     def ev(weights, X):
-        return jax.vmap(lambda x: mod.run(weights, x))(X)
+        with jax.default_matmul_precision("float32"):
+            return jax.vmap(lambda x: mod.run(weights, x))(X)
 
     return ev
 

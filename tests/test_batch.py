@@ -66,17 +66,21 @@ def test_batched_training_learns(tmp_path, snn, train):
     assert ok == len(names)
 
 
-def test_batched_eval_matches_per_sample(tmp_path, capsys):
+def test_batched_eval_matches_per_sample(tmp_path, capsys, monkeypatch):
     """run_kernel_batched emits the SAME stream as the per-sample
     driver — same verdicts in the same seeded shuffle order (ref order
     contract: src/libhpnn.c:1218-1229) — including the header-only line
-    for an unreadable file."""
+    for an unreadable file.  HPNN_NO_BATCH_EVAL pins run_kernel to its
+    TRUE per-sample forward so the comparison is between independent
+    numeric paths, not the shared vmapped eval."""
     from hpnn_tpu.utils import logging as log
 
     log.set_verbose(2)
     conf = _conf(tmp_path, n=12)
     (tmp_path / "samples" / "s99999.txt").write_text("[input] zero\n")
+    monkeypatch.setenv("HPNN_NO_BATCH_EVAL", "1")
     driver.run_kernel(conf)
+    monkeypatch.delenv("HPNN_NO_BATCH_EVAL")
     per_sample = capsys.readouterr().out
     (tmp_path / "b").mkdir()
     conf2 = _conf(tmp_path / "b", n=12)

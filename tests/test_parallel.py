@@ -150,6 +150,24 @@ def test_tp_run_fn(mesh4):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-14)
 
 
+@pytest.mark.parametrize("model", ["ann", "snn"])
+def test_tp_batched_run_fn(mesh4, model):
+    """Batched TP eval (one dispatch per chunk) == per-sample forward."""
+    n_in, hiddens, n_out = 12, [8], 4
+    weights = _make_kernel(7, n_in, hiddens, n_out)
+    mk = _sample_snn if model == "snn" else _sample
+    X = np.stack([np.asarray(mk(i, n_in, n_out)[0]) for i in range(6)])
+    fn = tp.make_batched_run_fn(mesh4, len(weights), model=model, n_out=n_out)
+    got = np.asarray(
+        fn(tp.shard_kernel(weights, mesh4), tp.replicate(jnp.asarray(X), mesh4))
+    )
+    mod = snn if model == "snn" else ann
+    for i in range(X.shape[0]):
+        np.testing.assert_allclose(
+            got[i], np.asarray(mod.run(weights, jnp.asarray(X[i]))), atol=1e-13
+        )
+
+
 # ---------------------------------------------------------------- DP
 
 

@@ -1,0 +1,98 @@
+"""synth_mnist generator + the act-gradient stability it exposed.
+
+The 60k-scale MNIST protocol runs on unnormalized 0-255 pixels
+(ref: prepare_mnist.c:49-52), which drive first-layer pre-activations
+to |z| ~ 300 — any exp in the backward pass overflows f32 there.
+"""
+
+import struct
+
+import numpy as np
+
+from hpnn_tpu.tools import synth_mnist
+
+
+def test_idx_files_roundtrip_through_pmnist(tmp_path, capsys, monkeypatch):
+    synth_mnist.main([str(tmp_path), "--train", "30", "--test", "10",
+                      "--seed", "3"])
+    with open(tmp_path / "train_images", "rb") as fp:
+        magic, n, r, c = struct.unpack(">IIII", fp.read(16))
+    assert (magic, n, r, c) == (0x803, 30, 28, 28)
+    with open(tmp_path / "test_labels", "rb") as fp:
+        magic, n = struct.unpack(">II", fp.read(8))
+    assert (magic, n) == (0x801, 10)
+
+    # the real pmnist converter consumes them unmodified
+    from hpnn_tpu.tools import pmnist
+
+    (tmp_path / "samples").mkdir()
+    (tmp_path / "tests").mkdir()
+    monkeypatch.chdir(tmp_path)
+    assert pmnist.main(["samples", "tests"]) == 0
+    assert len(list((tmp_path / "samples").iterdir())) == 30
+    assert len(list((tmp_path / "tests").iterdir())) == 10
+    s1 = (tmp_path / "samples" / "s00001.txt").read_text()
+    assert s1.startswith("[input] 784\n")
+    assert "[output] 10" in s1
+
+
+def test_generator_deterministic(tmp_path):
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    synth_mnist.main([str(a), "--train", "20", "--test", "5", "--seed", "9"])
+    synth_mnist.main([str(b), "--train", "20", "--test", "5", "--seed", "9"])
+    for f in ("train_images", "train_labels", "test_images", "test_labels"):
+        assert (a / f).read_bytes() == (b / f).read_bytes()
+
+
+def test_classes_distinguishable():
+    """Mean rendered image per class differs clearly across classes —
+    the task is learnable."""
+    rng = np.random.RandomState(0)
+    means = []
+    for d in range(10):
+        imgs = np.stack([synth_mnist.render(d, rng) for _ in range(12)])
+        means.append(imgs.mean(axis=0).ravel() / 255.0)
+    means = np.stack(means)
+    for i in range(10):
+        for j in range(i + 1, 10):
+            assert np.abs(means[i] - means[j]).mean() > 0.01
+
+
+def test_act_grad_finite_at_pixel_scale_f32():
+    """grad(act) stays finite for |z| ~ 300 in f32 (custom_jvp uses the
+    reference's dact identity, ref: src/ann.c:883-888); the naive exp
+    backward would be NaN at z=-212."""
+    import jax
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import ann
+    from hpnn_tpu.parallel import dp
+
+    z = jnp.asarray([-300.0, -88.5, 0.0, 88.5, 300.0], dtype=jnp.float32)
+    g = jax.grad(lambda v: jnp.sum(ann.act(v)))(z)
+    assert bool(jnp.isfinite(g).all())
+    # full batch-step gradient on pixel-scale inputs
+    rng = np.random.RandomState(0)
+    w = (
+        jnp.asarray(rng.uniform(-0.036, 0.036, (16, 64)), dtype=jnp.float32),
+        jnp.asarray(rng.uniform(-0.1, 0.1, (4, 16)), dtype=jnp.float32),
+    )
+    X = jnp.asarray(rng.uniform(0, 255, (8, 64)), dtype=jnp.float32)
+    T = jnp.asarray(np.full((8, 4), -1.0), dtype=jnp.float32)
+    grads = jax.grad(dp.batch_loss)(w, X, T, model="ann")
+    assert all(bool(jnp.isfinite(g).all()) for g in grads)
+
+
+def test_act_value_bit_identical():
+    """custom_jvp must not change the primal: same bits as the raw
+    exp form (parity mode depends on it)."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import ann
+
+    x = jnp.linspace(-30, 30, 1001, dtype=jnp.float64) \
+        if jnp.zeros(1).dtype == jnp.float64 else \
+        jnp.linspace(-30, 30, 1001, dtype=jnp.float32)
+    raw = 2.0 / (1.0 + jnp.exp(-x)) - 1.0
+    np.testing.assert_array_equal(np.asarray(ann.act(x)), np.asarray(raw))
