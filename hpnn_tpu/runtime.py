@@ -127,9 +127,20 @@ def init_dist() -> bool:
 
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = os.environ.get("JAX_NUM_PROCESSES")
-    if coord and nproc and int(nproc) > 1:
+    try:
+        nproc_n = int(nproc) if nproc else 0
+    except ValueError:
+        log.nn_warn(sys.stderr, "bad JAX_NUM_PROCESSES: %s\n", nproc)
+        nproc_n = 0
+    if coord and nproc_n > 1:
         try:
-            jax.distributed.initialize()
+            # explicit args: the no-arg form only auto-detects managed
+            # clusters (slurm/ompi); the env tuple is our `mpirun`
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(nproc),
+                process_id=int(os.environ["JAX_PROCESS_ID"]),
+            )
         except Exception as exc:  # already initialized or misconfigured
             log.nn_warn(sys.stderr, "distributed init failed: %s\n", exc)
     n = jax.process_count()

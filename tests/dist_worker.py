@@ -1,0 +1,69 @@
+"""Worker for the 2-process distributed test (tests/test_dist.py).
+
+Each OS process runs this script with JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID set — the JAX-distributed analogue
+of one rank of ``mpirun -np 2 train_nn`` (ref MPI init:
+/root/reference/src/libhpnn.c:182-200).  It joins the cluster through
+``runtime.init_dist``, builds the slice-aware ``dist.hybrid_mesh``,
+runs ONE GSPMD DP training step over the global 4-device (2 procs x 2
+local CPU devices) mesh, and prints one token line through the rank-0
+-only logger (the reference's ``_OUT``, common.h:81-91).
+"""
+
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+
+    from hpnn_tpu import runtime
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.parallel import dist, dp, mesh as mesh_mod
+    from hpnn_tpu.utils import logging as log
+
+    runtime.init_runtime()
+    log.set_verbose(2)  # NN_OUT prints at -vv (ref: include/libhpnn.h:95-122)
+    assert runtime.init_dist()
+    assert jax.process_count() == 2, jax.process_count()
+    assert runtime.get_capabilities() & runtime.NNCap.MPI
+    assert runtime.get_mpi_tasks() == 2
+
+    mesh = dist.hybrid_mesh(n_model=1)
+    n_data = mesh.shape[mesh_mod.DATA_AXIS]
+    assert n_data == jax.device_count() == 4
+
+    import jax.numpy as jnp
+
+    k, _ = kernel_mod.generate(7, 6, [5], 3)
+    weights = tuple(jnp.asarray(np.asarray(w)) for w in k.weights)
+    step = dp.make_gspmd_train_step(mesh, weights, model="ann",
+                                    momentum=False)
+    w_sh = dp.place_kernel(weights, mesh)
+
+    # the same global batch on every process; each device picks out its
+    # shard via the index callback (the multi-process twin of
+    # dp.shard_batch, which device_puts the whole batch single-process)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    B = 2 * n_data
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (B, 6))
+    T = np.full((B, 3), -1.0)
+    T[np.arange(B), rng.randint(0, 3, B)] = 1.0
+    b_sh = NamedSharding(mesh, P(mesh_mod.DATA_AXIS, None))
+    Xs = jax.make_array_from_callback(X.shape, b_sh, lambda idx: X[idx])
+    Ts = jax.make_array_from_callback(T.shape, b_sh, lambda idx: T[idx])
+
+    w_sh, _, loss = step(w_sh, (), Xs, Ts)
+    jax.block_until_ready(loss)
+    # rank-0-only token: exactly one process may emit this line
+    log.nn_out(sys.stdout, "DIST STEP loss= %.10f tasks=%i\n",
+               float(loss), runtime.get_mpi_tasks())
+    log.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
