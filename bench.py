@@ -183,36 +183,37 @@ def measure_reference(timeout_s: int = 600):
     if not (os.path.isdir(ref) and shutil.which("gcc")):
         return None
     d = tempfile.mkdtemp(prefix="hpnn_refbench_")
-    exe = os.path.join(d, "train_nn_ref")
-    build = subprocess.run(
-        ["gcc", "-O2", "-fopenmp", "-D_OMP", f"-I{ref}/include",
-         f"{ref}/src/libhpnn.c", f"{ref}/src/ann.c", f"{ref}/src/snn.c",
-         f"{ref}/tests/train_nn.c", "-lm", "-o", exe],
-        capture_output=True, text=True,
-    )
-    if build.returncode != 0:
-        return None
-    sdir = os.path.join(d, "samples")
-    os.mkdir(sdir)
-    for i, (x, t) in enumerate(make_workload()):
-        with open(os.path.join(sdir, f"s{i:05d}.txt"), "w") as fp:
-            fp.write("[input] 784\n" + " ".join("%7.5f" % v for v in x) + "\n")
-            fp.write("[output] 10\n" + " ".join("%.1f" % v for v in t) + "\n")
-    with open(os.path.join(d, "nn.conf"), "w") as fp:
-        fp.write(
-            "[name] B\n[type] ANN\n[init] generate\n[seed] 10958\n"
-            "[input] 784\n[hidden] 300\n[output] 10\n[train] BP\n"
-            "[sample_dir] ./samples\n[test_dir] ./samples\n"
-        )
     try:
-        t0 = time.perf_counter()
-        res = subprocess.run(
-            [exe, "-v", "-v", "-O", "4", "-B", "4", "nn.conf"],
-            cwd=d, capture_output=True, text=True, timeout=timeout_s,
+        exe = os.path.join(d, "train_nn_ref")
+        build = subprocess.run(
+            ["gcc", "-O2", "-fopenmp", "-D_OMP", f"-I{ref}/include",
+             f"{ref}/src/libhpnn.c", f"{ref}/src/ann.c", f"{ref}/src/snn.c",
+             f"{ref}/tests/train_nn.c", "-lm", "-o", exe],
+            capture_output=True, text=True,
         )
-        dt = time.perf_counter() - t0
-    except subprocess.TimeoutExpired:
-        return None
+        if build.returncode != 0:
+            return None
+        sdir = os.path.join(d, "samples")
+        os.mkdir(sdir)
+        for i, (x, t) in enumerate(make_workload()):
+            with open(os.path.join(sdir, f"s{i:05d}.txt"), "w") as fp:
+                fp.write("[input] 784\n" + " ".join("%7.5f" % v for v in x) + "\n")
+                fp.write("[output] 10\n" + " ".join("%.1f" % v for v in t) + "\n")
+        with open(os.path.join(d, "nn.conf"), "w") as fp:
+            fp.write(
+                "[name] B\n[type] ANN\n[init] generate\n[seed] 10958\n"
+                "[input] 784\n[hidden] 300\n[output] 10\n[train] BP\n"
+                "[sample_dir] ./samples\n[test_dir] ./samples\n"
+            )
+        try:
+            t0 = time.perf_counter()
+            res = subprocess.run(
+                [exe, "-v", "-v", "-O", "4", "-B", "4", "nn.conf"],
+                cwd=d, capture_output=True, text=True, timeout=timeout_s,
+            )
+            dt = time.perf_counter() - t0
+        except subprocess.TimeoutExpired:
+            return None
     finally:
         shutil.rmtree(d, ignore_errors=True)
     if res.returncode != 0:

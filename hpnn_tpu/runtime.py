@@ -132,14 +132,22 @@ def init_dist() -> bool:
     except ValueError:
         log.nn_warn(sys.stderr, "bad JAX_NUM_PROCESSES: %s\n", nproc)
         nproc_n = 0
-    if coord and nproc_n > 1:
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if coord and nproc_n > 1 and pid is None:
+        # fail loudly BEFORE the peers block in the init barrier
+        log.nn_error(
+            sys.stderr,
+            "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES set but "
+            "JAX_PROCESS_ID missing: running single-process\n",
+        )
+    elif coord and nproc_n > 1:
         try:
             # explicit args: the no-arg form only auto-detects managed
             # clusters (slurm/ompi); the env tuple is our `mpirun`
             jax.distributed.initialize(
                 coordinator_address=coord,
-                num_processes=int(nproc),
-                process_id=int(os.environ["JAX_PROCESS_ID"]),
+                num_processes=nproc_n,
+                process_id=int(pid),
             )
         except Exception as exc:  # already initialized or misconfigured
             log.nn_warn(sys.stderr, "distributed init failed: %s\n", exc)

@@ -152,8 +152,16 @@ def train_kernel_batched(
     weights = tuple(
         jnp.asarray(np.asarray(w), dtype=dtype) for w in conf.kernel.weights
     )
-    step = dp.make_gspmd_train_step(
-        mesh, weights, model=model, momentum=momentum, alpha=0.2
+    # one dispatch per EPOCH (lax.scan over minibatches): the per-step
+    # dispatch floor (~100 ms host round-trip vs ~1 ms device work on
+    # the MNIST topology) would otherwise dominate.  Single data shard:
+    # samples live on device once, batches gather by index; sharded
+    # data axis: host permutes and uploads per epoch.
+    n_data = mesh.shape[mesh_mod.DATA_AXIS]
+    gather = n_data == 1
+    epoch_fn = dp.make_gspmd_epoch_fn(
+        mesh, weights, model=model, momentum=momentum, alpha=0.2,
+        gather=gather,
     )
     eval_fn = make_eval_fn(model=model)
 
@@ -168,6 +176,12 @@ def train_kernel_batched(
 
     Xd = X.astype(dtype)
     Td = T.astype(dtype)
+    if gather:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(mesh, P())
+        X_dev = jax.device_put(jnp.asarray(Xd), rep)
+        T_dev = jax.device_put(jnp.asarray(Td), rep)
     if conf.seed == 0:  # 0 means "random", like the reference's srandom
         import time
 
@@ -192,14 +206,19 @@ def train_kernel_batched(
         # np.resize repeats the permutation as needed even when B > 2n
         if pad:
             order = np.resize(order, n + pad)
-        losses = []
-        for i in range(0, len(order), B):
-            idx = order[i : i + B]
-            Xs, Ts = dp.shard_batch(Xd[idx], Td[idx], mesh)
-            w_sh, dw_sh, l = step(w_sh, dw_sh, Xs, Ts)
-            losses.append(l)
-        loss = float(np.mean([float(l) for l in losses]))
-        out = np.asarray(eval_fn(w_sh, jnp.asarray(Xd)))
+        n_steps = len(order) // B
+        if gather:
+            idx = jnp.asarray(order.reshape(n_steps, B), dtype=jnp.int32)
+            w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, X_dev, T_dev, idx)
+        else:
+            Xe = Xd[order].reshape(n_steps, B, -1)
+            Te = Td[order].reshape(n_steps, B, -1)
+            Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
+            w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
+        loss = float(jnp.mean(losses))
+        # gather mode: the bank already lives on device — don't
+        # re-upload ~n*dim*4 bytes per epoch just to eval
+        out = np.asarray(eval_fn(w_sh, X_dev if gather else jnp.asarray(Xd)))
         okc = accuracy_counts(out, T, model)
         log.nn_out(
             sys.stdout,

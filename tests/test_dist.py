@@ -83,15 +83,16 @@ def test_two_process_cluster(tmp_path):
             )
         )
     outs = []
-    for rank, p in enumerate(procs):
-        try:
+    try:
+        for rank, p in enumerate(procs):
             out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        # never leak a worker blocked on a dead coordinator
+        for q in procs:
+            if q.poll() is None:
                 q.kill()
-            raise
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}\n{err}"
-        outs.append(out)
     # rank-0-only logging (_OUT, ref: common.h:81-91): the token line
     # appears exactly once, on the coordinator
     assert "NN: DIST STEP loss= " in outs[0]

@@ -181,6 +181,62 @@ def _batch(seed, B, n_in, n_out, snn_targets=False):
     return jnp.asarray(X), jnp.asarray(T)
 
 
+@pytest.mark.parametrize("gather,momentum", [
+    (True, False), (True, True), (False, False), (False, True),
+])
+def test_epoch_fn_matches_per_step_loop(gather, momentum):
+    """ONE scan-epoch dispatch == the per-step jit loop, same math
+    (both paths run dp.train_step_math), on a single-shard mesh
+    (gather strategy) and a 4-way data mesh (stream strategy)."""
+    mesh = mesh_mod.make_mesh(n_data=1 if gather else 4, n_model=1)
+    weights = _make_kernel(99, 6, [10], 4)
+    B, n_steps = 8, 3
+    rng = np.random.RandomState(3)
+    Xe = rng.uniform(-1, 1, (n_steps, B, 6))
+    Te = np.where(
+        rng.randint(0, 4, (n_steps, B, 1)) == np.arange(4), 1.0, -1.0
+    )
+
+    # reference: per-step jit
+    step = dp.make_gspmd_train_step(
+        mesh, weights, model="ann", momentum=momentum, donate=False
+    )
+    w_ref = dp.place_kernel(weights, mesh)
+    dw_ref = dp.place_kernel(
+        tuple(np.zeros_like(np.asarray(w)) for w in weights), mesh
+    ) if momentum else ()
+    losses_ref = []
+    for s in range(n_steps):
+        Xs, Ts = dp.shard_batch(Xe[s], Te[s], mesh)
+        w_ref, dw_ref, l = step(w_ref, dw_ref, Xs, Ts)
+        losses_ref.append(float(l))
+
+    # scan epoch
+    epoch_fn = dp.make_gspmd_epoch_fn(
+        mesh, weights, model="ann", momentum=momentum, donate=False,
+        gather=gather,
+    )
+    w_sh = dp.place_kernel(weights, mesh)
+    dw_sh = dp.place_kernel(
+        tuple(np.zeros_like(np.asarray(w)) for w in weights), mesh
+    ) if momentum else ()
+    if gather:
+        X_all = jnp.asarray(Xe.reshape(-1, 6))
+        T_all = jnp.asarray(Te.reshape(-1, 4))
+        idx = jnp.arange(n_steps * B, dtype=jnp.int32).reshape(n_steps, B)
+        w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, X_all, T_all, idx)
+    else:
+        Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
+        w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
+
+    np.testing.assert_allclose(np.asarray(losses), losses_ref, atol=1e-12)
+    for a, b in zip(w_sh, w_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+    if momentum:
+        for a, b in zip(dw_sh, dw_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+
+
 def test_dp_step_matches_host_math():
     """Explicit shard_map+pmean step == single-device batched grad step."""
     m = mesh_mod.make_mesh(n_data=8, n_model=1)
