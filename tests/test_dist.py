@@ -67,6 +67,9 @@ def test_two_process_cluster(tmp_path):
     }
     env_base["JAX_PLATFORMS"] = "cpu"
     env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # repo root only (cwd is tmp_path; the plugin's sitecustomize dir
+    # stripped above must NOT come back)
+    env_base["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
     env_base["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
     env_base["JAX_NUM_PROCESSES"] = "2"
     procs = []
