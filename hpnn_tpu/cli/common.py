@@ -123,6 +123,15 @@ def validate_long_opts(opts: dict) -> bool:
         if len(parts) != 2 or not all(p.isdigit() and int(p) >= 1 for p in parts):
             sys.stderr.write("syntax error: bad --mesh parameter (want DxM)!\n")
             return False
+    lr = opts.get("lr")
+    if lr is not None:
+        try:
+            ok = float(lr) > 0.0
+        except ValueError:
+            ok = False
+        if not ok:
+            sys.stderr.write("syntax error: bad --lr parameter!\n")
+            return False
     return True
 
 

@@ -18,15 +18,19 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
-        argv, valued=("batch", "epochs", "mesh", "profile")
+        argv, valued=("batch", "epochs", "mesh", "profile", "lr")
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
         return -1
-    if "batch" not in opts and "epochs" in opts:
-        sys.stderr.write("syntax error: --epochs requires --batch!\n")
-        runtime.deinit_all()
-        return -1
+    for needs_batch in ("epochs", "lr"):
+        if "batch" not in opts and needs_batch in opts:
+            # per-sample mode keeps the reference's fixed learning
+            # rates (ref: src/ann.c LEARN_RATE dead-define quirk) and
+            # epoch notion; these knobs only exist for minibatch SGD
+            sys.stderr.write(f"syntax error: --{needs_batch} requires --batch!\n")
+            runtime.deinit_all()
+            return -1
     tp_mesh = None
     if "mesh" in opts and "batch" not in opts:
         # per-sample TP: the reference's `mpirun -np X train_nn` mode
@@ -61,6 +65,7 @@ def main(argv: list[str] | None = None) -> int:
                 batch_size=int(opts["batch"]),
                 epochs=int(opts.get("epochs", "1")),
                 mesh_spec=opts.get("mesh"),
+                lr=float(opts["lr"]) if "lr" in opts else None,
             )
         else:
             ok = driver.train_kernel(conf, mesh=tp_mesh)

@@ -118,8 +118,17 @@ def train_kernel_batched(
     batch_size: int,
     epochs: int,
     mesh_spec: str | None = None,
+    lr: float | None = None,
 ) -> bool:
-    """Minibatch-SGD training round over ``conf.samples``."""
+    """Minibatch-SGD training round over ``conf.samples``.
+
+    ``lr=None`` keeps the reference's per-sample learning rate for the
+    model/mode (ann.BP_LEARN_RATE etc.); ``--lr`` overrides it — batch
+    gradients are means over B samples, so tasks with many outputs
+    (e.g. the 230-class XRD protocol, where the one-hot signal is
+    diluted 1:229 and tanh saturates) need a larger step than the
+    per-sample protocol's η to escape the all-negative plateau.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -160,7 +169,7 @@ def train_kernel_batched(
     n_data = mesh.shape[mesh_mod.DATA_AXIS]
     gather = n_data == 1
     epoch_fn = dp.make_gspmd_epoch_fn(
-        mesh, weights, model=model, momentum=momentum, alpha=0.2,
+        mesh, weights, model=model, momentum=momentum, lr=lr, alpha=0.2,
         gather=gather,
     )
     eval_fn = make_eval_fn(model=model)

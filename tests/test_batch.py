@@ -124,3 +124,32 @@ def test_accuracy_counts_quirks():
     T2 = np.array([[1.0, 0.0]])
     # SNN: no positive output -> guess stays 0 == is_ok 0
     assert batch_mod.accuracy_counts(out2, T2, "snn") == 1
+
+
+def test_lr_override_threads_through(tmp_path):
+    """--lr equivalent: a huge lr changes the trajectory vs default."""
+    conf_a = _conf(tmp_path, n=8)
+    conf_b = _conf_copy(conf_a)  # same data + kernel, different lr
+    assert batch_mod.train_kernel_batched(conf_a, batch_size=8, epochs=3)
+    assert batch_mod.train_kernel_batched(conf_b, batch_size=8, epochs=3,
+                                          lr=5.0)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        for a, b in zip(conf_a.kernel.weights, conf_b.kernel.weights)
+    )
+
+
+def _conf_copy(conf):
+    k = kernel_mod.Kernel(tuple(np.asarray(w).copy() for w in conf.kernel.weights))
+    return NNConf(name=conf.name, type=conf.type, seed=conf.seed, kernel=k,
+                  train=conf.train, samples=conf.samples, tests=conf.tests)
+
+
+def test_cli_lr_requires_batch(tmp_path, capsys, monkeypatch):
+    from hpnn_tpu.cli import train_nn as cli
+
+    monkeypatch.chdir(tmp_path)
+    assert cli.main(["--lr", "0.4", "nn.conf"]) == -1
+    assert "requires --batch" in capsys.readouterr().err
+    assert cli.main(["--batch", "8", "--lr", "bogus", "nn.conf"]) == -1
+    assert "bad --lr" in capsys.readouterr().err

@@ -152,3 +152,46 @@ def test_gen_ann_cli_roundtrip(tmp_path):
     kfile.write_text(res.stdout)
     _, k = kernel_mod.load(str(kfile))
     assert k.n_inputs == 4
+
+
+def test_synth_rruff_pdif_pipeline(tmp_path, capsys):
+    """synth_rruff emits dif/raw pairs the real pdif converts: every
+    good file becomes an 851-in/230-out sample one-hot on its space
+    group; quirk files are skipped; generation is deterministic."""
+    from hpnn_tpu.tools import synth_rruff
+
+    out = tmp_path / "rruff"
+    assert synth_rruff.main(
+        [str(out), "--per-class", "2", "--classes", "5", "--quirks",
+         "--seed", "11"]
+    ) == 0
+    sdir = tmp_path / "samples"
+    sdir.mkdir()
+    assert pdif.main([str(out), "-i", "850", "-o", "230",
+                      "-s", str(sdir)]) == 0
+    err = capsys.readouterr().err
+    # Mo-radiation + first-line "5.000" quirks skipped like the reference
+    assert err.count("SKIP") == 2
+    made = sorted(p.name for p in sdir.iterdir())
+    # 10 good samples + the unknown-SG file (all −1 outputs, kept)
+    assert len(made) == 11
+    for g in range(1, 6):
+        for j in range(2):
+            name = "R%06i" % ((g - 1) * 2 + j + 1)
+            lines = (sdir / name).read_text().splitlines()
+            assert lines[0] == "[input] 851"
+            x = np.array([float(v) for v in lines[1].split()])
+            assert x.shape == (851,) and np.all(x <= 1.3)
+            t = [float(v) for v in lines[3].split()]
+            assert len(t) == 230 and t.index(1.0) == g - 1
+    # unknown space group -> all −1 target (reference space==0 path)
+    tq = [float(v)
+          for v in (sdir / "RQ00003").read_text().splitlines()[3].split()]
+    assert 1.0 not in tq
+    # determinism: same seed regenerates byte-identical files
+    out2 = tmp_path / "rruff2"
+    synth_rruff.main([str(out2), "--per-class", "2", "--classes", "5",
+                      "--quirks", "--seed", "11"])
+    for sub in ("dif", "raw"):
+        for p in sorted((out / sub).iterdir()):
+            assert p.read_bytes() == (out2 / sub / p.name).read_bytes()

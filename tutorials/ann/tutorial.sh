@@ -7,18 +7,46 @@
 # with pdif -i 850 -o 230, then trains an 851-230-230 ANN with BPM
 # (alpha=0.2, ref conf: tutorial.bash:9) for 1 + N_ROUNDS rounds; the
 # test set is a copy of the samples (ref: tutorial.bash:151-158).
+#
+# Usage: tutorial.sh [--batch] [--synth]
+#   --batch  use the TPU minibatch mode (BATCH_SIZE/EPOCHS env override)
+#   --synth  no-network mode: generate the deterministic synthetic
+#            RRUFF-scale dif/raw dataset (synth_rruff, seed 10958)
+#            instead of downloading; same container format, same
+#            pdif conversion, same pipeline
 set -u
 N_ROUNDS=${N_ROUNDS:-10}
+BATCH_MODE=
+SYNTH_MODE=
+for arg in "$@"; do
+    case "$arg" in
+    --batch) BATCH_MODE=y;;
+    --synth) SYNTH_MODE=y;;
+    esac
+done
+
 for tool in pdif train_nn run_nn; do
     command -v "$tool" >/dev/null || { echo "Can't find $tool!"; exit 1; }
 done
+
+if [ ! -d ./rruff/dif ] && [ -n "$SYNTH_MODE" ]; then
+    command -v synth_rruff >/dev/null || { echo "Can't find synth_rruff!"; exit 1; }
+    # generate into a temp dir and move into place so an interrupted
+    # generation (or a partial ./rruff) can't leave a half-built tree
+    rm -rf rruff.tmp && mkdir -p rruff.tmp
+    synth_rruff rruff.tmp --per-class "${SYNTH_PER_CLASS:-16}" \
+        --seed "${SYNTH_SEED:-10958}" --quirks || exit 1
+    mkdir -p rruff && mv rruff.tmp/* rruff/ && rmdir rruff.tmp
+fi
+
 [ -d ./rruff/dif ] && [ -d ./rruff/raw ] || {
     echo "RRUFF data not found: need ./rruff/dif and ./rruff/raw"
-    echo "(download the XRD dif + raw archives from rruff.info)"
+    echo "(download the XRD dif + raw archives from rruff.info,"
+    echo " or pass --synth for the no-network synthetic dataset)"
     exit 1
 }
 rm -rf samples tests && mkdir -p samples tests
-pdif ./rruff -i 850 -o 230 -s ./samples || exit 1
+pdif ./rruff -i 850 -o 230 -s ./samples > pdif.log 2> pdif.err || exit 1
 cp ./samples/* ./tests/
 
 cat > xrd.conf <<'EOF'
@@ -35,14 +63,23 @@ cat > xrd.conf <<'EOF'
 EOF
 sed -e 's/^\[init\].*/[init] kernel.opt/g' xrd.conf > cont_xrd.conf
 
+BATCH_ARGS=
+# batch defaults tuned for this protocol: the 230-class ±1 one-hot
+# dilutes the batch-mean gradient 1:229 and tanh saturates at the
+# all-negative plateau — measured: η=0.0005..0.1 stalls at ~1% train
+# accuracy, η=0.4 reaches >99.9% by ~1600 epochs (BASELINE.md).  The
+# per-sample mode keeps the reference's faithful η (it escapes the
+# plateau by converging every sample individually instead).
+[ -n "$BATCH_MODE" ] && BATCH_ARGS="--batch ${BATCH_SIZE:-256} --epochs ${EPOCHS:-400} --lr ${BATCH_LR:-0.4}"
+
 rm -f raw log results; touch raw log
-train_nn -v -v -v ./xrd.conf &> log
+train_nn -v -v -v $BATCH_ARGS ./xrd.conf &> log
 run_nn -v -v ./cont_xrd.conf &> results
 N=$(grep -c 'TESTING' results || true)
 NRS=$(grep -c PASS results || true)
 echo "0 $NRS/$N" >> raw; tail -1 raw
 for IDX in $(seq 1 "$N_ROUNDS"); do
-    train_nn -v -v -v ./cont_xrd.conf &> log
+    train_nn -v -v -v $BATCH_ARGS ./cont_xrd.conf &> log
     run_nn -v -v ./cont_xrd.conf &> results
     NRS=$(grep -c PASS results || true)
     echo "$IDX $NRS/$N" >> raw; tail -1 raw

@@ -1,0 +1,21 @@
+# Shared round monitor for the MNIST tutorials — sourced by
+# tutorial.sh and opt_mnist.sh (both count PASS from run_nn output and
+# the OPT numerator from the train log; the batch mode prints no
+# per-sample ' OK ', so the last BATCH EPOCH accuracy count stands in,
+# format: hpnn_tpu/train/batch.py BATCH EPOCH line).
+#
+# Expects: $BATCH_MODE, $N_TRAIN_FILES, $N_TEST_FILES, ./log, ./results
+# Appends "<round> <PASS%> <OPT%>" to ./raw and echoes it.
+round_eval() {
+    NRS=$(grep -c PASS results || true)
+    if [ -n "$BATCH_MODE" ]; then
+        NOK=$(grep "BATCH EPOCH" log | tail -1 | sed 's/.*(\([0-9]*\)\/.*/\1/')
+        NOK=${NOK:-0}
+    else
+        NOK=$(grep -c ' OK ' log || true)
+    fi
+    XRS=$(awk -v n="$NRS" -v d="$N_TEST_FILES" 'BEGIN{printf "%.1f", 100*n/d}')
+    XOK=$(awk -v n="$NOK" -v d="$N_TRAIN_FILES" 'BEGIN{printf "%.1f", 100*n/d}')
+    echo "$1 $XRS $XOK" >> raw
+    tail -1 raw
+}

@@ -2,8 +2,23 @@
 # MNIST SNN variant — 30 rounds, softmax output + cross-entropy
 # (ref: /root/reference/tutorials/mnist/opt_mnist.bash).  Run from the
 # same directory as tutorial.sh AFTER its data preparation (./mnist).
+#
+# Usage: opt_mnist.sh [--batch]
+#   --batch  use the TPU minibatch mode (BATCH_SIZE/EPOCHS env override)
+#
+# Unlike the ANN monitor, the reference's SNN variant divides PASS by
+# the test count and OK by the train count correctly
+# (ref: opt_mnist.bash:38-44); this port keeps that but takes the
+# denominators from the converted sets instead of hardcoding 60k/10k.
 set -u
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 N_ROUNDS=${N_ROUNDS:-30}
+BATCH_MODE=
+for arg in "$@"; do
+    case "$arg" in
+    --batch) BATCH_MODE=y;;
+    esac
+done
 cd mnist || { echo "run tutorial.sh first (needs ./mnist)"; exit 1; }
 
 cat > mnist_snn.conf <<'EOF'
@@ -21,16 +36,19 @@ EOF
 sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' \
     mnist_snn.conf > cont_mnist_snn.conf
 
+BATCH_ARGS=
+[ -n "$BATCH_MODE" ] && BATCH_ARGS="--batch ${BATCH_SIZE:-256} --epochs ${EPOCHS:-5}"
+
 rm -f raw log results; touch raw log
-train_nn -v -v ./mnist_snn.conf &> log
+N_TRAIN_FILES=$(ls samples | wc -l)
+N_TEST_FILES=$(ls tests | wc -l)
+. "$SCRIPT_DIR/monitor.sh"
+train_nn -v -v $BATCH_ARGS ./mnist_snn.conf &> log
 run_nn -v -v -v -v ./cont_mnist_snn.conf &> results
-NRS=$(grep -c PASS results || true); NOK=$(grep -c ' OK ' log || true)
-echo "1 $(awk -v n="$NRS" 'BEGIN{printf "%.1f",100*n/10000}') $(awk -v n="$NOK" 'BEGIN{printf "%.1f",100*n/60000}')" > raw
+round_eval 1
 for IDX in $(seq 2 "$N_ROUNDS"); do
-    train_nn -v -v ./cont_mnist_snn.conf &> log
+    train_nn -v -v $BATCH_ARGS ./cont_mnist_snn.conf &> log
     run_nn -v -v -v -v ./cont_mnist_snn.conf &> results
-    NRS=$(grep -c PASS results || true); NOK=$(grep -c ' OK ' log || true)
-    echo "$IDX $(awk -v n="$NRS" 'BEGIN{printf "%.1f",100*n/10000}') $(awk -v n="$NOK" 'BEGIN{printf "%.1f",100*n/60000}')" >> raw
-    tail -1 raw
+    round_eval "$IDX"
 done
 echo "All DONE!"

@@ -17,6 +17,7 @@
 #            MNIST-scale dataset (synth_mnist, seed 10958) instead of
 #            downloading; same idx container format, same pipeline
 set -u
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 N_ROUNDS=${N_ROUNDS:-50}
 BATCH_MODE=
 SYNTH_MODE=
@@ -91,21 +92,7 @@ rm -f raw log results; touch raw log
 # which would mis-scale SYNTH_TRAIN/SYNTH_TEST-sized runs)
 N_TRAIN_FILES=$(ls samples | wc -l)
 N_TEST_FILES=$(ls tests | wc -l)
-round_eval() {
-    NRS=$(grep -c PASS results || true)
-    if [ -n "$BATCH_MODE" ]; then
-        # batch mode prints no per-sample OK; use the last epoch's
-        # train-set-correct count as the OPT numerator
-        NOK=$(grep "BATCH EPOCH" log | tail -1 | sed 's/.*(\([0-9]*\)\/.*/\1/')
-        NOK=${NOK:-0}
-    else
-        NOK=$(grep -c ' OK ' log || true)
-    fi
-    XRS=$(awk -v n="$NRS" -v d="$N_TEST_FILES" 'BEGIN{printf "%.1f", 100*n/d}')
-    XOK=$(awk -v n="$NOK" -v d="$N_TRAIN_FILES" 'BEGIN{printf "%.1f", 100*n/d}')
-    echo "$1 $XRS $XOK" >> raw
-    tail -1 raw
-}
+. "$SCRIPT_DIR/monitor.sh"
 # first pass (generate + train + eval)
 train_nn -v -v -v $BATCH_ARGS ./mnist_ann.conf &> log
 run_nn -v -v ./cont_mnist_ann.conf &> results
