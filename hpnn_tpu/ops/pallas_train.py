@@ -280,3 +280,182 @@ def train_sample_fused(
         final_ok[0].astype(bool),
         out[0],
     )
+
+
+# ---------------------------------------------------------------------------
+# Batched (M-dimension) fused minibatch step: the MXU-shaped variant.
+#
+# One whole DP training step — forward, deltas, weight update, post-
+# update re-forward and loss — as ONE kernel with every activation,
+# delta and weight resident in VMEM (MNIST topology at B=1024 is
+# ~11 MB of the ~16 MB/core budget).  Against the XLA scan path
+# (dp.make_gspmd_epoch_fn) this trades XLA's op-by-op HBM round trips
+# for on-chip reuse; both are measured head-to-head in BASELINE.md and
+# bench.py keeps whichever story the numbers tell.
+#
+# Semantics are dp.train_step_math's exactly (mean-of-batch loss, the
+# same SGD/BPM triad, post-update loss) for ANN; SNN stays on the XLA
+# path because its batched gradient is autodiff-of-softmax-CE (with
+# the TINY clamp), not the per-sample hand delta, and duplicating that
+# here would invite silent drift.  tests/test_pallas.py proves step
+# parity against train_step_math in interpret mode.
+# ---------------------------------------------------------------------------
+
+
+def _batch_step_kernel(
+    x_ref,
+    t_ref,
+    *refs,
+    n_layers: int,
+    momentum: bool,
+    lr: float,
+    alpha: float,
+    inv_b: float,
+):
+    # ref layout: [aliased input state refs (ignored), output state
+    # refs, loss ref, then scratch: acts and deltas per layer]
+    n_state = n_layers * (2 if momentum else 1)
+    out_state = refs[n_state : 2 * n_state]
+    w = list(out_state[:n_layers])
+    dw = list(out_state[n_layers:]) if momentum else []
+    loss_ref = refs[2 * n_state]
+    acts = list(refs[2 * n_state + 1 : 2 * n_state + 1 + n_layers])
+    ds = list(refs[2 * n_state + 1 + n_layers : 2 * n_state + 1 + 2 * n_layers])
+
+    x = x_ref[:]
+    t = t_ref[:]
+
+    def forward():
+        v = x
+        for l in range(n_layers):
+            z = lax.dot_general(
+                v,
+                w[l][:],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=_F32,
+            )
+            v = ann.act(z)
+            acts[l][:] = v
+
+    forward()
+    # deltas (B, out_l): output layer then back-propagated
+    ds[-1][:] = (t - acts[-1][:]) * ann.dact(acts[-1][:])
+    for l in range(n_layers - 2, -1, -1):
+        part = lax.dot_general(
+            ds[l + 1][:],
+            w[l + 1][:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=_F32,
+        )
+        ds[l][:] = part * ann.dact(acts[l][:])
+    # weight updates from the MEAN gradient (lr/B · δᵀ·v)
+    for l in range(n_layers):
+        v_prev = x if l == 0 else acts[l - 1][:]
+        outer = lax.dot_general(
+            ds[l][:],
+            v_prev,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=_F32,
+        )
+        if momentum:
+            m = dw[l][:] + (lr * inv_b) * outer
+            w[l][:] = w[l][:] + m
+            dw[l][:] = alpha * m
+        else:
+            w[l][:] = w[l][:] + (lr * inv_b) * outer
+    # post-update loss, like train_step_math's re-forward
+    forward()
+    d = t - acts[-1][:]
+    loss_ref[0] = 0.5 * jnp.sum(d * d) * inv_b
+
+
+@functools.partial(
+    jax.jit, static_argnames=("momentum", "lr", "alpha", "interpret")
+)
+def train_step_fused_batch(
+    weights,
+    dw,
+    X,
+    T,
+    *,
+    momentum: bool = False,
+    lr: float | None = None,
+    alpha: float = 0.2,
+    interpret: bool = False,
+):
+    """Fused ANN minibatch step; drop-in for ``dp.train_step_math``
+    (ANN only).  Returns (weights, dw, loss)."""
+    n_layers = len(weights)
+    if lr is None:
+        lr = ann.BPM_LEARN_RATE if momentum else ann.BP_LEARN_RATE
+    weights = tuple(jnp.asarray(wl, dtype=_F32) for wl in weights)
+    dw = tuple(jnp.asarray(m, dtype=_F32) for m in dw) if momentum else ()
+    X = jnp.asarray(X, dtype=_F32)
+    T = jnp.asarray(T, dtype=_F32)
+    B = X.shape[0]
+
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem1 = pl.BlockSpec(memory_space=pltpu.SMEM)
+    n_state = n_layers * (2 if momentum else 1)
+    out_shape = (
+        tuple(jax.ShapeDtypeStruct(wl.shape, _F32) for wl in weights)
+        + (tuple(jax.ShapeDtypeStruct(m.shape, _F32) for m in dw)
+           if momentum else ())
+        + (jax.ShapeDtypeStruct((1,), _F32),)  # loss
+    )
+    out_specs = tuple(vmem for _ in range(n_state)) + (smem1,)
+    in_specs = [vmem, vmem] + [vmem] * n_state
+    aliases = {2 + i: i for i in range(n_state)}
+    scratch = [
+        pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights
+    ] + [pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights]
+
+    kernel = functools.partial(
+        _batch_step_kernel,
+        n_layers=n_layers,
+        momentum=momentum,
+        lr=float(lr),
+        alpha=float(alpha),
+        inv_b=1.0 / B,
+    )
+    results = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(X, T, *weights, *dw)
+    new_w = tuple(results[:n_layers])
+    new_dw = tuple(results[n_layers : 2 * n_layers]) if momentum else ()
+    return new_w, new_dw, results[n_state][0]
+
+
+def make_pallas_epoch_fn(weights, *, momentum: bool = False,
+                         lr: float | None = None, alpha: float = 0.2,
+                         interpret: bool = False):
+    """Scan-per-epoch trainer over the fused batch kernel — the Pallas
+    twin of ``dp.make_gspmd_epoch_fn(gather=True)`` (single device,
+    ANN only).  epoch(weights, dw, X_bank, T_bank, idx) -> (weights,
+    dw, per-step losses), with idx (n_steps, B) gathering each step's
+    minibatch from the on-device bank."""
+    if lr is None:
+        lr = ann.BPM_LEARN_RATE if momentum else ann.BP_LEARN_RATE
+
+    def epoch(weights, dw, X_bank, T_bank, idx):
+        def body(carry, ix):
+            w, m = carry
+            w, m, l = train_step_fused_batch(
+                w, m, X_bank[ix], T_bank[ix],
+                momentum=momentum, lr=lr, alpha=alpha, interpret=interpret,
+            )
+            return (w, m), l
+        (weights, dw), losses = lax.scan(body, (weights, dw), idx)
+        return weights, dw, losses
+
+    # NO donate_argnums here: donating the weight carry on top of the
+    # kernel's input_output_aliases trips the TPU runtime
+    # (INVALID_ARGUMENT on dispatch, observed on v5e) — the aliasing
+    # already keeps the update in place inside the scan.
+    return jax.jit(epoch)

@@ -53,7 +53,7 @@ GRID_LO, GRID_HI, GRID_STEP = 5.0, 90.0, 0.02
 def class_peaks(space: int, seed: int):
     """Deterministic characteristic peaks for one space group:
     (positions [K], relative intensities [K]) with K in 8..16."""
-    rng = np.random.RandomState(seed * 1009 + space)
+    rng = np.random.RandomState((seed * 1009 + space) % 2**32)
     k = int(rng.randint(8, 17))
     pos = np.sort(rng.uniform(7.0, 88.0, size=k))
     inten = rng.lognormal(mean=0.0, sigma=0.8, size=k)

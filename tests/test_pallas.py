@@ -65,3 +65,62 @@ def test_fused_deep_kernel():
     assert int(got.n_iter) == int(ref.n_iter)
     for a, b in zip(got.weights, ref.weights):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("momentum", [False, True])
+def test_batch_step_matches_train_step_math(momentum):
+    """Fused batched step == dp.train_step_math (ANN), interpret mode."""
+    from hpnn_tpu.parallel import dp
+
+    weights, _, _ = _setup(42, 12, [16], 6)
+    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    rng = np.random.RandomState(5)
+    B = 16
+    X = jnp.asarray(rng.uniform(-1, 1, (B, 12)), dtype=jnp.float32)
+    T = np.full((B, 6), -1.0, dtype=np.float32)
+    T[np.arange(B), rng.randint(0, 6, B)] = 1.0
+    T = jnp.asarray(T)
+
+    lr = 0.05
+    rw, rdw, rloss = dp.train_step_math(
+        weights, dw, X, T, model="ann", momentum=momentum, lr=lr, alpha=0.2
+    )
+    gw, gdw, gloss = pallas_train.train_step_fused_batch(
+        weights, dw, X, T, momentum=momentum, lr=lr, alpha=0.2,
+        interpret=True,
+    )
+    np.testing.assert_allclose(float(gloss), float(rloss), rtol=1e-5)
+    for a, b in zip(gw, rw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    if momentum:
+        for a, b in zip(gdw, rdw):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_pallas_epoch_matches_gspmd_epoch():
+    """Scan-per-epoch over the fused batch kernel == the XLA epoch."""
+    from hpnn_tpu.parallel import dp, mesh as mesh_mod
+
+    weights, _, _ = _setup(13, 10, [12], 4)
+    rng = np.random.RandomState(3)
+    n, B, steps = 64, 16, 4
+    X = jnp.asarray(rng.uniform(-1, 1, (n, 10)), dtype=jnp.float32)
+    T = np.full((n, 4), -1.0, dtype=np.float32)
+    T[np.arange(n), rng.randint(0, 4, n)] = 1.0
+    T = jnp.asarray(T)
+    idx = jnp.asarray(rng.permutation(n)[: steps * B].reshape(steps, B))
+
+    mesh = mesh_mod.make_mesh(n_data=1, n_model=1)
+    ref_fn = dp.make_gspmd_epoch_fn(mesh, weights, model="ann",
+                                    momentum=False, lr=0.05, gather=True,
+                                    donate=False)
+    rw, _, rlosses = ref_fn(weights, (), X, T, idx)
+
+    pal_fn = pallas_train.make_pallas_epoch_fn(weights, momentum=False,
+                                               lr=0.05, interpret=True)
+    gw, _, glosses = pal_fn(weights, (), X, T, idx)
+    np.testing.assert_allclose(
+        np.asarray(glosses), np.asarray(rlosses), rtol=1e-5
+    )
+    for a, b in zip(gw, rw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

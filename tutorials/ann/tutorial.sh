@@ -29,14 +29,19 @@ for tool in pdif train_nn run_nn; do
     command -v "$tool" >/dev/null || { echo "Can't find $tool!"; exit 1; }
 done
 
-if [ ! -d ./rruff/dif ] && [ -n "$SYNTH_MODE" ]; then
+if [ ! -d ./rruff ] && [ -n "$SYNTH_MODE" ]; then
     command -v synth_rruff >/dev/null || { echo "Can't find synth_rruff!"; exit 1; }
-    # generate into a temp dir and move into place so an interrupted
-    # generation (or a partial ./rruff) can't leave a half-built tree
+    # generate into a temp dir and rename into place so an interrupted
+    # generation can't leave a partial ./rruff that a re-run trusts
     rm -rf rruff.tmp && mkdir -p rruff.tmp
     synth_rruff rruff.tmp --per-class "${SYNTH_PER_CLASS:-16}" \
         --seed "${SYNTH_SEED:-10958}" --quirks || exit 1
-    mkdir -p rruff && mv rruff.tmp/* rruff/ && rmdir rruff.tmp
+    mv rruff.tmp rruff
+elif [ -n "$SYNTH_MODE" ] && { [ ! -d ./rruff/dif ] || [ ! -d ./rruff/raw ]; }; then
+    # never merge synthetic data into a half-present real tree
+    echo "partial ./rruff exists (missing dif/ or raw/): remove it or"
+    echo "complete it before re-running --synth"
+    exit 1
 fi
 
 [ -d ./rruff/dif ] && [ -d ./rruff/raw ] || {
