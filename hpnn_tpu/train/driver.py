@@ -126,17 +126,64 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
 
     if conf.seed == 0:
         conf.seed = int(time.time())
-    for fname in _shuffled_files(conf.samples, conf.seed):
-        log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
-        sample = sample_io.read_sample(os.path.join(conf.samples, fname))
-        if sample is None:
-            continue
-        tr_in, tr_out = sample
-        if momentum:
-            dw = dw0  # raz_momentum: fresh zeros each sample
-        res = train_one(weights, dw, tr_in, tr_out)
-        weights, dw = res.weights, res.dw
-        _print_train_tokens(res, model, momentum)
+    files = list(_shuffled_files(conf.samples, conf.seed))
+    # fused rounds don't apply to the TP path (the scan body would need
+    # the shard_map trainer) nor when the per-sample Pallas study is
+    # explicitly requested (HPNN_PALLAS=1 dispatches the Mosaic kernel
+    # from the streaming loop — fusing would silently bypass it)
+    parsed = bank = None
+    if (
+        tp_state is None
+        and os.environ.get("HPNN_FUSE_EPOCH", "1") != "0"
+        and not loop._pallas_eligible(weights)
+    ):
+        parsed = [
+            sample_io.read_sample(os.path.join(conf.samples, f))
+            for f in files
+        ]
+        bank = _stack_epoch_bank(parsed, dtype)
+    if bank is not None:
+        # whole round in one dispatch (loop.train_epoch_lax); the token
+        # stream is emitted afterwards, byte-identical to the streaming
+        # path (same math, same order — tests/test_reference_parity.py)
+        X, T = bank
+        weights, stats = loop.train_epoch_lax(
+            weights, dw0, jnp.asarray(X), jnp.asarray(T),
+            alpha, delta,
+            model=model, momentum=momentum,
+            min_iter=min_iter, max_iter=max_iter,
+        )
+        stats = tuple(np.asarray(s) for s in stats)
+        i = 0
+        for fname, sample in zip(files, parsed):
+            log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
+            if sample is None:
+                continue  # header-only line, like the streaming path
+            res = loop.SampleResult(
+                (), (), stats[0][i], stats[1][i], stats[2][i],
+                stats[3][i], stats[4][i], None,
+            )
+            _print_train_tokens(res, model, momentum)
+            i += 1
+    else:
+        # streaming path; reuse the pre-parsed samples when a fused
+        # attempt bailed (ragged dims) rather than re-reading the dir
+        pairs = (
+            zip(files, parsed) if parsed is not None else (
+                (f, sample_io.read_sample(os.path.join(conf.samples, f)))
+                for f in files
+            )
+        )
+        for fname, sample in pairs:
+            log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
+            if sample is None:
+                continue
+            tr_in, tr_out = sample
+            if momentum:
+                dw = dw0  # raz_momentum: fresh zeros each sample
+            res = train_one(weights, dw, tr_in, tr_out)
+            weights, dw = res.weights, res.dw
+            _print_train_tokens(res, model, momentum)
     if tp_state is not None:
         from hpnn_tpu.parallel import mesh as mesh_mod
 
@@ -147,6 +194,20 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     else:
         conf.kernel = kernel_mod.Kernel(tuple(np.asarray(w) for w in weights))
     return True
+
+
+def _stack_epoch_bank(parsed, dtype):
+    """Stack pre-parsed samples (unreadable entries are None) into the
+    fused-epoch (X, T) bank, or None when the round can't be fused: no
+    readable samples, or ragged dimensions (the scan needs one static
+    shape; the streaming path handles such dirs sample by sample)."""
+    xs = [np.asarray(s[0], dtype=dtype) for s in parsed if s is not None]
+    ts = [np.asarray(s[1], dtype=dtype) for s in parsed if s is not None]
+    if not xs:
+        return None
+    if len({x.shape for x in xs}) > 1 or len({t.shape for t in ts}) > 1:
+        return None
+    return np.stack(xs), np.stack(ts)
 
 
 def _tp_shard(mesh, weights_np):

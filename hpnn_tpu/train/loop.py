@@ -217,3 +217,54 @@ def run_sample(weights, x, *, model: str = "ann"):
     """Forward pass only (``ann_kernel_run``/``snn_kernel_run``)."""
     mod = snn if model == "snn" else ann
     return mod.run(weights, x)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "momentum", "min_iter", "max_iter")
+)
+def train_epoch_lax(
+    weights,
+    dw0,
+    X,
+    T,
+    alpha,
+    delta,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int = MIN_BP_ITER,
+    max_iter: int = MAX_BP_ITER,
+):
+    """A whole faithful round in ONE dispatch: ``lax.scan`` over the
+    (already shuffled) samples, each scanned step running the exact
+    per-sample convergence loop with the weights carried sample to
+    sample — the reference's sequential protocol, unchanged.
+
+    The streaming driver pays one host dispatch per sample; on the
+    tunneled TPU that round trip (~65-80 ms) dwarfs many samples'
+    device time, so a 60k-sample round loses over an hour to pure
+    dispatch.  Scanning on device removes it while keeping the math
+    identical (same ``train_sample_lax`` body, inlined under the scan).
+
+    Momentum raz quirk preserved: every sample starts from ``dw0``
+    (fresh zeros — ``ann_raz_momentum``, ref: src/ann.c:1921-1938),
+    so ``dw`` never carries across samples and is not returned.
+
+    Returns ``(weights, stats)`` where stats is a tuple of per-sample
+    arrays ``(ep0, n_iter, dep, first_ok, final_ok)`` in sample order
+    — exactly the five scalars the token printer needs.
+    """
+
+    def body(w, xt):
+        x, t = xt
+        res = train_sample_lax(
+            w, dw0, x, t, alpha, delta,
+            model=model, momentum=momentum,
+            min_iter=min_iter, max_iter=max_iter,
+        )
+        return res.weights, (
+            res.ep0, res.n_iter, res.dep, res.first_ok, res.final_ok
+        )
+
+    weights, stats = jax.lax.scan(body, weights, (X, T))
+    return weights, stats
