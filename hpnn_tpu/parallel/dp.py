@@ -36,6 +36,19 @@ from hpnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 def sample_loss(weights, x, target, *, model: str = "ann"):
     mod = snn if model == "snn" else ann
+    if model == "snn":
+        # Batch-mode target interpretation: the pmnist/pdif container
+        # writes ±1 one-hots (ANN convention, ref: tutorials/mnist/
+        # prepare_mnist.c:54-58) and the reference's per-sample SNN
+        # consumes them raw — its argmax convergence criterion is
+        # insensitive to the resulting common-mode logit sink.  A batch
+        # MEAN of δ = t−o with t=−1 on 9 of 10 outputs is not: every
+        # logit sinks ~0.8·η per step until exp underflows and
+        # training freezes at chance (measured on the 60k bank).
+        # Clamping −1 → 0 restores the standard softmax-CE reading of
+        # the same files (mean δ = p_class − o, balanced) — 99.8%
+        # after one epoch at the faithful η, where raw ±1 freezes.
+        target = jnp.maximum(target, 0.0)
     return mod.train_error(mod.forward(weights, x)[-1], target)
 
 
@@ -43,6 +56,44 @@ def batch_loss(weights, X, T, *, model: str = "ann"):
     """Mean per-sample error over the batch's leading axis."""
     losses = jax.vmap(lambda x, t: sample_loss(weights, x, t, model=model))(X, T)
     return jnp.mean(losses)
+
+
+def batch_grads(weights, X, T, *, model: str):
+    """Mean gradient over the batch, the reference's way.
+
+    ANN: ``jax.grad`` of the mean loss — exactly the delta rule, since
+    ``ann.act``'s custom JVP is the reference's own ``dact(y)``
+    identity (tests/test_ann_numerics.py pins the equality).
+
+    SNN: the reference's hand delta ``δ = t − o`` (src/snn.c:510-512 —
+    the softmax+CE shortcut, applied WITHOUT the softmax Jacobian its
+    quirky ``exp(z−1)/(TINY+Σ)`` forward would actually require), NOT
+    autodiff.  This matters beyond faithfulness: on raw 0-255 inputs
+    the f32 softmax saturates fully (wrong-class ``o`` underflows to
+    exactly 0), the true gradient ``o(1−o)`` is exactly zero, and the
+    autodiff path goes numerically dead — measured on the 60k MNIST
+    bank: loss frozen at −23.20, accuracy pinned at chance for any lr.
+    δ = t − o keeps the full training signal through saturation, like
+    the reference's per-sample loop does.
+    """
+    if model == "ann":
+        return jax.grad(batch_loss)(weights, X, T, model=model)
+
+    def sample_deltas(w, x, t):
+        acts = snn.forward(w, x)
+        # same −1 → 0 clamp as sample_loss (see its comment)
+        return acts, snn.deltas(w, acts, jnp.maximum(t, 0.0))
+
+    acts, ds = jax.vmap(
+        lambda x, t: sample_deltas(weights, x, t)
+    )(X, T)
+    inv_b = 1.0 / X.shape[0]
+    grads = []
+    for l, _w in enumerate(weights):
+        v_prev = acts[l]  # acts[0] is x itself
+        # sgd_step does W −= lr·g, the reference does W += η·δ⊗v
+        grads.append(-inv_b * jnp.einsum("bo,bi->oi", ds[l], v_prev))
+    return tuple(grads)
 
 
 def sgd_step(weights, grads, lr):
@@ -77,7 +128,7 @@ def make_dp_train_step(mesh, *, model: str = "ann", momentum: bool = False,
         lr = default_lr(model, momentum)
 
     def local_step(weights, dw, X_loc, T_loc):
-        grads = jax.grad(batch_loss)(weights, X_loc, T_loc, model=model)
+        grads = batch_grads(weights, X_loc, T_loc, model=model)
         grads = tuple(lax.pmean(g, DATA_AXIS) for g in grads)
         if momentum:
             weights, dw = momentum_step(weights, dw, grads, lr, alpha)
@@ -130,7 +181,7 @@ def train_step_math(weights, dw, X, T, *, model: str, momentum: bool,
                     lr: float, alpha: float):
     """One minibatch steepest-descent step + post-update loss — the
     shared body of the per-step jit and the scan-per-epoch trainer."""
-    grads = jax.grad(batch_loss)(weights, X, T, model=model)
+    grads = batch_grads(weights, X, T, model=model)
     if momentum:
         weights, dw = momentum_step(weights, dw, grads, lr, alpha)
     else:

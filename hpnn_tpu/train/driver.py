@@ -127,6 +127,10 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     if conf.seed == 0:
         conf.seed = int(time.time())
     files = list(_shuffled_files(conf.samples, conf.seed))
+    # expected sample dims; a mismatched file is skipped with a warning
+    # in both paths (the reference reads it into out-of-bounds C memory
+    # — undefined behavior with nothing to be faithful to)
+    exp_dims = (weights_np[0].shape[-1], weights_np[-1].shape[0])
     # fused rounds don't apply to the TP path (the scan body would need
     # the shard_map trainer) nor when the per-sample Pallas study is
     # explicitly requested (HPNN_PALLAS=1 dispatches the Mosaic kernel
@@ -138,8 +142,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         and not loop._pallas_eligible(weights)
     ):
         parsed = [
-            sample_io.read_sample(os.path.join(conf.samples, f))
-            for f in files
+            _checked_sample(conf.samples, f, exp_dims) for f in files
         ]
         bank = _stack_epoch_bank(parsed, dtype)
     if bank is not None:
@@ -147,6 +150,10 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         # stream is emitted afterwards, byte-identical to the streaming
         # path (same math, same order — tests/test_reference_parity.py)
         X, T = bank
+        # the token loop below only needs the readable mask — drop the
+        # parsed host arrays (~hundreds of MB at 60k-sample scale)
+        readable = [s is not None for s in parsed]
+        parsed = bank = None
         weights, stats = loop.train_epoch_lax(
             weights, dw0, jnp.asarray(X), jnp.asarray(T),
             alpha, delta,
@@ -155,9 +162,9 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         )
         stats = tuple(np.asarray(s) for s in stats)
         i = 0
-        for fname, sample in zip(files, parsed):
+        for fname, was_read in zip(files, readable):
             log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
-            if sample is None:
+            if not was_read:
                 continue  # header-only line, like the streaming path
             res = loop.SampleResult(
                 (), (), stats[0][i], stats[1][i], stats[2][i],
@@ -170,7 +177,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         # attempt bailed (ragged dims) rather than re-reading the dir
         pairs = (
             zip(files, parsed) if parsed is not None else (
-                (f, sample_io.read_sample(os.path.join(conf.samples, f)))
+                (f, _checked_sample(conf.samples, f, exp_dims))
                 for f in files
             )
         )
@@ -196,16 +203,29 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     return True
 
 
+def _checked_sample(sample_dir, fname, exp_dims):
+    """read_sample + kernel-dimension check; mismatches are skipped
+    with a warning (→ None, a header-only token line)."""
+    sample = sample_io.read_sample(os.path.join(sample_dir, fname))
+    if sample is None:
+        return None
+    if sample[0].shape[0] != exp_dims[0] or sample[1].shape[0] != exp_dims[1]:
+        log.nn_error(
+            sys.stderr,
+            "sample %s dimension mismatch (%ix%i, kernel %ix%i)! SKIP\n",
+            fname, sample[0].shape[0], sample[1].shape[0], *exp_dims,
+        )
+        return None
+    return sample
+
+
 def _stack_epoch_bank(parsed, dtype):
-    """Stack pre-parsed samples (unreadable entries are None) into the
-    fused-epoch (X, T) bank, or None when the round can't be fused: no
-    readable samples, or ragged dimensions (the scan needs one static
-    shape; the streaming path handles such dirs sample by sample)."""
+    """Stack pre-parsed, dimension-checked samples (skipped entries are
+    None) into the fused-epoch (X, T) bank, or None when nothing is
+    trainable."""
     xs = [np.asarray(s[0], dtype=dtype) for s in parsed if s is not None]
     ts = [np.asarray(s[1], dtype=dtype) for s in parsed if s is not None]
     if not xs:
-        return None
-    if len({x.shape for x in xs}) > 1 or len({t.shape for t in ts}) > 1:
         return None
     return np.stack(xs), np.stack(ts)
 

@@ -133,3 +133,49 @@ def test_snn_output_delta_no_dact(setup):
     np.testing.assert_allclose(
         np.asarray(ds[-1]), t - np.asarray(acts[-1]), atol=1e-14
     )
+
+
+@pytest.mark.parametrize("model,momentum", [
+    ("ann", False), ("ann", True), ("snn", False),
+])
+def test_epoch_scan_matches_sequential(model, momentum):
+    """loop.train_epoch_lax == sequential train_sample_lax calls:
+    same carried weights, same five per-sample stats (the fused-round
+    driver path vs the streaming path)."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.train import loop
+
+    k, _ = kernel_mod.generate(31, 9, [7], 4)
+    weights = tuple(jnp.asarray(np.asarray(w), dtype=jnp.float64)
+                    for w in k.weights)
+    dw0 = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    rng = np.random.RandomState(8)
+    n = 6
+    X = rng.uniform(-1, 1, (n, 9))
+    lo = 0.0 if model == "snn" else -1.0
+    T = np.full((n, 4), lo)
+    T[np.arange(n), rng.randint(0, 4, n)] = 1.0
+    kw = dict(model=model, momentum=momentum, min_iter=5, max_iter=80)
+
+    w_seq = weights
+    seq_stats = []
+    for i in range(n):
+        res = loop.train_sample_lax(
+            w_seq, dw0, jnp.asarray(X[i]), jnp.asarray(T[i]), 0.2, 1e-6,
+            **kw,
+        )
+        w_seq = res.weights
+        seq_stats.append((float(res.ep0), int(res.n_iter), float(res.dep),
+                          bool(res.first_ok), bool(res.final_ok)))
+
+    w_fused, stats = loop.train_epoch_lax(
+        weights, dw0, jnp.asarray(X), jnp.asarray(T), 0.2, 1e-6, **kw,
+    )
+    for i in range(n):
+        got = (float(stats[0][i]), int(stats[1][i]), float(stats[2][i]),
+               bool(stats[3][i]), bool(stats[4][i]))
+        assert got == seq_stats[i], f"sample {i}: {got} != {seq_stats[i]}"
+    for a, b in zip(w_fused, w_seq):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -152,3 +152,34 @@ def _rows(kernel_text):
 def test_tp_cli_rejects_data_axis(workdir, capsys):
     conf = _conf(workdir)
     assert train_nn.main(["--mesh", "2x2", conf]) == -1
+
+
+def test_fused_round_token_alignment_with_bad_files(workdir, capsys,
+                                                    monkeypatch):
+    """Fused-round edge cases: an unreadable or dimension-mismatched
+    sample file must produce a header-only line with every other file's
+    tokens unshifted — stream identical to HPNN_FUSE_EPOCH=0."""
+    conf = _conf(workdir)
+    # corrupt one file mid-shuffle: read_sample -> None
+    (workdir / "samples" / "s00007.txt").write_text("garbage\n")
+
+    def run(fuse):
+        monkeypatch.setenv("HPNN_FUSE_EPOCH", fuse)
+        assert train_nn.main(["-v", "-v", "-v", conf]) == 0
+        return capsys.readouterr().out
+
+    fused, streamed = run("1"), run("0")
+    assert fused == streamed
+    # the corrupt file's line is header-only: filename then next header
+    m = re.search(r"TRAINING FILE: *s00007.txt\s*\t(NN: TRAINING|$)", fused)
+    assert m, fused
+    assert fused.count("N_ITER=") == 19
+
+    # dimension mismatch: skipped with a warning in BOTH paths (the
+    # reference's behavior here is out-of-bounds C reads — undefined)
+    _write_sample(workdir / "samples" / "s00007.txt",
+                  np.zeros(5), np.array([1.0, -1.0]))
+    fused2, streamed2 = run("1"), run("0")
+    assert fused2 == streamed2
+    assert fused2.count("N_ITER=") == 19
+    assert re.search(r"TRAINING FILE: *s00007.txt\s*\tNN: TRAINING", fused2)
