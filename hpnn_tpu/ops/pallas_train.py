@@ -294,11 +294,10 @@ def train_sample_fused(
 # bench.py keeps whichever story the numbers tell.
 #
 # Semantics are dp.train_step_math's exactly (mean-of-batch loss, the
-# same SGD/BPM triad, post-update loss) for ANN; SNN stays on the XLA
-# path because its batched gradient is autodiff-of-softmax-CE (with
-# the TINY clamp), not the per-sample hand delta, and duplicating that
-# here would invite silent drift.  tests/test_pallas.py proves step
-# parity against train_step_math in interpret mode.
+# same SGD/BPM triad, post-update loss) for both models — SNN uses the
+# same hand delta + 0/1 target reading as dp.batch_grads (see its
+# saturation rationale).  tests/test_pallas.py proves step parity
+# against train_step_math in interpret mode.
 # ---------------------------------------------------------------------------
 
 
@@ -307,6 +306,7 @@ def _batch_step_kernel(
     t_ref,
     *refs,
     n_layers: int,
+    model: str,
     momentum: bool,
     lr: float,
     alpha: float,
@@ -324,6 +324,10 @@ def _batch_step_kernel(
 
     x = x_ref[:]
     t = t_ref[:]
+    if model == "snn":
+        # batch mode reads the ±1 container one-hots as 0/1
+        # (dp.sample_loss's clamp — see its comment)
+        t = jnp.maximum(t, 0.0)
 
     def forward():
         v = x
@@ -334,12 +338,20 @@ def _batch_step_kernel(
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=_F32,
             )
-            v = ann.act(z)
+            if model == "snn" and l == n_layers - 1:
+                e = jnp.exp(z - 1.0)  # quirk: exp(z−1), no max-shift
+                v = e / (snn.TINY + jnp.sum(e, axis=1, keepdims=True))
+            else:
+                v = ann.act(z)
             acts[l][:] = v
 
     forward()
     # deltas (B, out_l): output layer then back-propagated
-    ds[-1][:] = (t - acts[-1][:]) * ann.dact(acts[-1][:])
+    if model == "snn":
+        # hand rule δ = t − o (dp.batch_grads — NOT autodiff)
+        ds[-1][:] = t - acts[-1][:]
+    else:
+        ds[-1][:] = (t - acts[-1][:]) * ann.dact(acts[-1][:])
     for l in range(n_layers - 2, -1, -1):
         part = lax.dot_general(
             ds[l + 1][:],
@@ -365,12 +377,17 @@ def _batch_step_kernel(
             w[l][:] = w[l][:] + (lr * inv_b) * outer
     # post-update loss, like train_step_math's re-forward
     forward()
-    d = t - acts[-1][:]
-    loss_ref[0] = 0.5 * jnp.sum(d * d) * inv_b
+    if model == "snn":
+        o = acts[-1][:]
+        n_out = o.shape[1]
+        loss_ref[0] = -jnp.sum(t * jnp.log(o + snn.TINY)) * inv_b / n_out
+    else:
+        d = t - acts[-1][:]
+        loss_ref[0] = 0.5 * jnp.sum(d * d) * inv_b
 
 
 @functools.partial(
-    jax.jit, static_argnames=("momentum", "lr", "alpha", "interpret")
+    jax.jit, static_argnames=("model", "momentum", "lr", "alpha", "interpret")
 )
 def train_step_fused_batch(
     weights,
@@ -378,16 +395,19 @@ def train_step_fused_batch(
     X,
     T,
     *,
+    model: str = "ann",
     momentum: bool = False,
     lr: float | None = None,
     alpha: float = 0.2,
     interpret: bool = False,
 ):
-    """Fused ANN minibatch step; drop-in for ``dp.train_step_math``
-    (ANN only).  Returns (weights, dw, loss)."""
+    """Fused minibatch step; drop-in for ``dp.train_step_math``
+    (ANN and SNN).  Returns (weights, dw, loss)."""
     n_layers = len(weights)
     if lr is None:
-        lr = ann.BPM_LEARN_RATE if momentum else ann.BP_LEARN_RATE
+        from hpnn_tpu.parallel import dp
+
+        lr = dp.default_lr(model, momentum)
     weights = tuple(jnp.asarray(wl, dtype=_F32) for wl in weights)
     dw = tuple(jnp.asarray(m, dtype=_F32) for m in dw) if momentum else ()
     X = jnp.asarray(X, dtype=_F32)
@@ -413,6 +433,7 @@ def train_step_fused_batch(
     kernel = functools.partial(
         _batch_step_kernel,
         n_layers=n_layers,
+        model=model,
         momentum=momentum,
         lr=float(lr),
         alpha=float(alpha),
@@ -432,22 +453,22 @@ def train_step_fused_batch(
     return new_w, new_dw, results[n_state][0]
 
 
-def make_pallas_epoch_fn(weights, *, momentum: bool = False,
+def make_pallas_epoch_fn(weights, *, model: str = "ann",
+                         momentum: bool = False,
                          lr: float | None = None, alpha: float = 0.2,
                          interpret: bool = False):
     """Scan-per-epoch trainer over the fused batch kernel — the Pallas
-    twin of ``dp.make_gspmd_epoch_fn(gather=True)`` (single device,
-    ANN only).  epoch(weights, dw, X_bank, T_bank, idx) -> (weights,
-    dw, per-step losses), with idx (n_steps, B) gathering each step's
-    minibatch from the on-device bank."""
-    if lr is None:
-        lr = ann.BPM_LEARN_RATE if momentum else ann.BP_LEARN_RATE
+    twin of ``dp.make_gspmd_epoch_fn(gather=True)`` (single device).
+    epoch(weights, dw, X_bank, T_bank, idx) -> (weights, dw, per-step
+    losses), with idx (n_steps, B) gathering each step's minibatch
+    from the on-device bank.  ``lr=None`` resolves inside the step
+    (dp.default_lr)."""
 
     def epoch(weights, dw, X_bank, T_bank, idx):
         def body(carry, ix):
             w, m = carry
             w, m, l = train_step_fused_batch(
-                w, m, X_bank[ix], T_bank[ix],
+                w, m, X_bank[ix], T_bank[ix], model=model,
                 momentum=momentum, lr=lr, alpha=alpha, interpret=interpret,
             )
             return (w, m), l

@@ -168,12 +168,12 @@ def train_kernel_batched(
     # data axis: host permutes and uploads per epoch.
     n_data = mesh.shape[mesh_mod.DATA_AXIS]
     gather = n_data == 1
-    # fused Pallas step where it measures faster: ANN on one TPU chip
+    # fused Pallas step where it measures faster: one TPU chip
     # (BASELINE.md head-to-head: +9..19% steps/s over the XLA scan at
     # the MNIST/XRD topologies, loss-identical; parity proven in
     # tests/test_pallas.py).  HPNN_PALLAS=0 forces the XLA path;
-    # multi-device meshes and SNN always use GSPMD (the fused kernel
-    # is single-device and ANN-only).
+    # multi-device meshes always use GSPMD (the fused kernel is
+    # single-device).
     # working set must fit the ~16 MB/core VMEM budget: batch X/T, the
     # acts+deltas scratch (2·B·Σout_l), and the weights (aliased
     # in-place, counted once) — otherwise Mosaic fails to compile where
@@ -187,8 +187,7 @@ def train_kernel_batched(
         + n_w * (2 if momentum else 1)
     )
     use_pallas = (
-        model == "ann"
-        and gather
+        gather
         and mesh.devices.size == 1
         and jax.default_backend() == "tpu"
         and dtype == jnp.float32  # fused kernel is f32-only
@@ -199,7 +198,7 @@ def train_kernel_batched(
         from hpnn_tpu.ops import pallas_train
 
         epoch_fn = pallas_train.make_pallas_epoch_fn(
-            weights, momentum=momentum, lr=lr, alpha=0.2,
+            weights, model=model, momentum=momentum, lr=lr, alpha=0.2,
         )
     else:
         epoch_fn = dp.make_gspmd_epoch_fn(

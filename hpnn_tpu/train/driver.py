@@ -124,9 +124,18 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     # are zeroed per sample (ann_raz_momentum inside train_BPM).
     dw = dw0
 
-    if conf.seed == 0:
+    # crash-resume for long fused rounds (HPNN_FUSE_STATE=<path>): the
+    # checkpoint carries the resolved seed, so a resumed `[seed] 0`
+    # round replays the SAME shuffle it started with
+    state_path = os.environ.get("HPNN_FUSE_STATE")
+    state = _load_fuse_state(state_path, conf.samples)
+    if state is not None:
+        conf.seed = int(state["seed"])
+    elif conf.seed == 0:
         conf.seed = int(time.time())
     files = list(_shuffled_files(conf.samples, conf.seed))
+    if state is not None and int(state["seed"]) != conf.seed:
+        state = None  # unrelated checkpoint: start over
     # expected sample dims; a mismatched file is skipped with a warning
     # in both paths (the reference reads it into out-of-bounds C memory
     # — undefined behavior with nothing to be faithful to)
@@ -146,35 +155,77 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         ]
         bank = _stack_epoch_bank(parsed, dtype)
     if bank is not None:
-        # whole round in one dispatch (loop.train_epoch_lax); the token
-        # stream is emitted afterwards, byte-identical to the streaming
-        # path (same math, same order — tests/test_reference_parity.py)
+        # fused rounds: the shuffled samples scan on device in chunks
+        # of HPNN_FUSE_CHUNK (default 2048) with the weights carried
+        # chunk to chunk — identical math and token stream to the
+        # streaming path (tests/test_reference_parity.py), one dispatch
+        # per chunk instead of per sample.  Chunking (a) bounds a
+        # single dispatch's run time — a whole-60k-round dispatch
+        # (~1.5 h) was observed to die with 'TPU worker process
+        # crashed' on the tunneled platform — and (b) streams the
+        # token output with progress instead of going silent for the
+        # full round.
         X, T = bank
         # the token loop below only needs the readable mask — drop the
         # parsed host arrays (~hundreds of MB at 60k-sample scale)
         readable = [s is not None for s in parsed]
         parsed = bank = None
-        weights, stats = loop.train_epoch_lax(
-            weights, dw0, jnp.asarray(X), jnp.asarray(T),
-            alpha, delta,
-            model=model, momentum=momentum,
-            min_iter=min_iter, max_iter=max_iter,
-        )
-        stats = tuple(np.asarray(s) for s in stats)
-        i = 0
-        for fname, was_read in zip(files, readable):
-            log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
-            if not was_read:
-                continue  # header-only line, like the streaming path
-            res = loop.SampleResult(
-                (), (), stats[0][i], stats[1][i], stats[2][i],
-                stats[3][i], stats[4][i], None,
+        chunk = max(1, int(os.environ.get("HPNN_FUSE_CHUNK", "2048")))
+        start_chunk = 0
+        if state is not None:
+            # resume: restore chunk-carried weights; tokens for
+            # completed chunks were printed by the previous process
+            start_chunk = int(state["next_chunk"])
+            weights = tuple(
+                jnp.asarray(w, dtype=dtype) for w in state["weights"]
             )
-            _print_train_tokens(res, model, momentum)
-            i += 1
+        fname_it = iter(zip(files, readable))
+
+        def emit_header_only_until_readable(silent=False):
+            """Print header-only lines for unreadable files until the
+            next readable one; returns its fname or None.  ``silent``
+            consumes without printing (resume skip)."""
+            for fname, was_read in fname_it:
+                if not silent:
+                    log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
+                if was_read:
+                    return fname
+            return None
+
+        for _ in range(start_chunk * chunk):  # resume: skip printed part
+            if emit_header_only_until_readable(silent=True) is None:
+                break
+        for ci, c0 in enumerate(range(0, X.shape[0], chunk)):
+            if ci < start_chunk:
+                continue
+            Xc = jnp.asarray(X[c0 : c0 + chunk])
+            Tc = jnp.asarray(T[c0 : c0 + chunk])
+            weights, stats = loop.train_epoch_lax(
+                weights, dw0, Xc, Tc,
+                alpha, delta,
+                model=model, momentum=momentum,
+                min_iter=min_iter, max_iter=max_iter,
+            )
+            stats = tuple(np.asarray(s) for s in stats)
+            if state_path:
+                _save_fuse_state(
+                    state_path, conf.samples, conf.seed, ci + 1, weights)
+            for i in range(Xc.shape[0]):
+                if emit_header_only_until_readable() is None:
+                    break
+                res = loop.SampleResult(
+                    (), (), stats[0][i], stats[1][i], stats[2][i],
+                    stats[3][i], stats[4][i], None,
+                )
+                _print_train_tokens(res, model, momentum)
+        # trailing unreadable files still get their header lines
+        emit_header_only_until_readable()
+        if state_path and os.path.exists(state_path):
+            os.remove(state_path)  # round completed
     else:
-        # streaming path; reuse the pre-parsed samples when a fused
-        # attempt bailed (ragged dims) rather than re-reading the dir
+        # streaming path; reuse pre-parsed samples when a fused attempt
+        # bailed (zero trainable samples — all entries None) rather
+        # than re-reading the dir
         pairs = (
             zip(files, parsed) if parsed is not None else (
                 (f, _checked_sample(conf.samples, f, exp_dims))
@@ -201,6 +252,47 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     else:
         conf.kernel = kernel_mod.Kernel(tuple(np.asarray(w) for w in weights))
     return True
+
+
+def _fuse_state_key(sample_dir):
+    """Round identity for crash-resume checkpoints: the sample dir's
+    file census (resume is only valid against the same directory)."""
+    import hashlib
+
+    names = sample_io.list_sample_files(sample_dir)
+    return hashlib.sha256("\n".join(names).encode()).hexdigest()
+
+
+def _load_fuse_state(path, sample_dir):
+    """Load a fused-round crash-resume checkpoint, or None when absent
+    or belonging to a different sample directory."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        z = np.load(path, allow_pickle=False)
+        if str(z["key"]) != _fuse_state_key(sample_dir):
+            return None
+        n = int(z["n_layers"])
+        return {
+            "seed": int(z["seed"]),
+            "next_chunk": int(z["next_chunk"]),
+            "weights": tuple(z[f"w{i}"] for i in range(n)),
+        }
+    except Exception:
+        return None  # unreadable/partial checkpoint: start over
+
+
+def _save_fuse_state(path, sample_dir, seed, next_chunk, weights):
+    """Atomically checkpoint a fused round after a completed chunk."""
+    tmp = path + ".tmp"
+    arrs = {f"w{i}": np.asarray(w) for i, w in enumerate(weights)}
+    np.savez(
+        tmp, key=_fuse_state_key(sample_dir), seed=seed,
+        next_chunk=next_chunk, n_layers=len(weights), **arrs,
+    )
+    # np.savez appends .npz to names without it
+    src = tmp if os.path.exists(tmp) else tmp + ".npz"
+    os.replace(src, path)
 
 
 def _checked_sample(sample_dir, fname, exp_dims):

@@ -183,3 +183,72 @@ def test_fused_round_token_alignment_with_bad_files(workdir, capsys,
     assert fused2 == streamed2
     assert fused2.count("N_ITER=") == 19
     assert re.search(r"TRAINING FILE: *s00007.txt\s*\tNN: TRAINING", fused2)
+
+
+def test_fused_round_chunked_matches_streaming(workdir, capsys, monkeypatch):
+    """HPNN_FUSE_CHUNK smaller than the sample count: chunk-carried
+    weights + chunked token emission == the streaming path."""
+    conf = _conf(workdir)
+
+    def run(env):
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        assert train_nn.main(["-v", "-v", "-v", conf]) == 0
+        return capsys.readouterr().out
+
+    chunked = run({"HPNN_FUSE_EPOCH": "1", "HPNN_FUSE_CHUNK": "3"})
+    streamed = run({"HPNN_FUSE_EPOCH": "0"})
+    assert chunked == streamed
+    assert chunked.count("N_ITER=") == 20
+
+
+def test_fused_round_crash_resume(workdir, capsys, monkeypatch):
+    """HPNN_FUSE_STATE: a round killed mid-chunk resumes from the
+    checkpoint — concatenated token stream and final weights identical
+    to an uninterrupted streaming round."""
+    from hpnn_tpu import config
+    from hpnn_tpu.train import driver, loop
+
+    conf_path = _conf(workdir)
+    monkeypatch.setenv("HPNN_FUSE_EPOCH", "0")
+    assert train_nn.main(["-v", "-v", "-v", conf_path]) == 0
+    want = capsys.readouterr().out
+    want_kernel = open("kernel.opt").read()
+
+    state = workdir / "round.state"
+    monkeypatch.setenv("HPNN_FUSE_EPOCH", "1")
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "6")
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    # crash the TPU-worker way: die inside the SECOND chunk dispatch
+    real_epoch = loop.train_epoch_lax
+    calls = {"n": 0}
+
+    def dying_epoch(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("TPU worker process crashed (simulated)")
+        return real_epoch(*a, **kw)
+
+    monkeypatch.setattr(loop, "train_epoch_lax", dying_epoch)
+    conf = config.load_conf(conf_path)
+    with pytest.raises(RuntimeError):
+        driver.train_kernel(conf)
+    part1 = capsys.readouterr().out
+    assert state.exists()  # checkpoint left behind after chunk 1
+
+    # new "process": resume and finish the round
+    monkeypatch.setattr(loop, "train_epoch_lax", real_epoch)
+    conf2 = config.load_conf(conf_path)
+    assert driver.train_kernel(conf2) is True
+    part2 = capsys.readouterr().out
+
+    def training_lines(s):
+        return [ln for ln in s.splitlines() if "TRAINING FILE" in ln]
+
+    # the two partial runs each re-print kernel-generation headers;
+    # the round's sample token stream is the contract
+    assert training_lines(part1 + part2) == training_lines(want)
+    assert not state.exists()  # completed round cleans up
+    with open("kernel.opt", "w") as fp:
+        config.dump_kernel(conf2, fp)
+    assert open("kernel.opt").read() == want_kernel

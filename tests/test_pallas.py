@@ -67,9 +67,14 @@ def test_fused_deep_kernel():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-@pytest.mark.parametrize("momentum", [False, True])
-def test_batch_step_matches_train_step_math(momentum):
-    """Fused batched step == dp.train_step_math (ANN), interpret mode."""
+@pytest.mark.parametrize("model,momentum", [
+    ("ann", False), ("ann", True), ("snn", False), ("snn", True),
+])
+def test_batch_step_matches_train_step_math(model, momentum):
+    """Fused batched step == dp.train_step_math, interpret mode.
+
+    SNN targets deliberately use the ±1 container convention here so
+    the kernel's clamp is exercised against dp's."""
     from hpnn_tpu.parallel import dp
 
     weights, _, _ = _setup(42, 12, [16], 6)
@@ -83,10 +88,10 @@ def test_batch_step_matches_train_step_math(momentum):
 
     lr = 0.05
     rw, rdw, rloss = dp.train_step_math(
-        weights, dw, X, T, model="ann", momentum=momentum, lr=lr, alpha=0.2
+        weights, dw, X, T, model=model, momentum=momentum, lr=lr, alpha=0.2
     )
     gw, gdw, gloss = pallas_train.train_step_fused_batch(
-        weights, dw, X, T, momentum=momentum, lr=lr, alpha=0.2,
+        weights, dw, X, T, model=model, momentum=momentum, lr=lr, alpha=0.2,
         interpret=True,
     )
     np.testing.assert_allclose(float(gloss), float(rloss), rtol=1e-5)
