@@ -124,18 +124,27 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     # are zeroed per sample (ann_raz_momentum inside train_BPM).
     dw = dw0
 
-    # crash-resume for long fused rounds (HPNN_FUSE_STATE=<path>): the
-    # checkpoint carries the resolved seed, so a resumed `[seed] 0`
-    # round replays the SAME shuffle it started with
+    # crash-resume for long fused rounds (HPNN_FUSE_STATE=<path>).
+    # The checkpoint key binds the round identity (sample-dir census +
+    # model/mode/topology), and the stored seed lets a `[seed] 0`
+    # round replay the SAME shuffle it started with; an explicitly
+    # seeded conf never adopts a checkpoint from a different seed.
     state_path = os.environ.get("HPNN_FUSE_STATE")
-    state = _load_fuse_state(state_path, conf.samples)
+    state_key = None
+    state = None
+    if state_path:
+        state_key = _fuse_state_key(
+            conf.samples, model, momentum,
+            tuple(w.shape for w in weights_np),
+        )
+        state = _load_fuse_state(state_path, state_key)
+        if state is not None and conf.seed not in (0, int(state["seed"])):
+            state = None  # different seeded round requested: start over
     if state is not None:
         conf.seed = int(state["seed"])
     elif conf.seed == 0:
         conf.seed = int(time.time())
     files = list(_shuffled_files(conf.samples, conf.seed))
-    if state is not None and int(state["seed"]) != conf.seed:
-        state = None  # unrelated checkpoint: start over
     # expected sample dims; a mismatched file is skipped with a warning
     # in both paths (the reference reads it into out-of-bounds C memory
     # — undefined behavior with nothing to be faithful to)
@@ -173,9 +182,12 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         chunk = max(1, int(os.environ.get("HPNN_FUSE_CHUNK", "2048")))
         start_chunk = 0
         if state is not None:
-            # resume: restore chunk-carried weights; tokens for
-            # completed chunks were printed by the previous process
+            # resume: restore chunk-carried weights AND the original
+            # run's chunk size (a different HPNN_FUSE_CHUNK would skip
+            # the wrong number of samples); tokens for completed
+            # chunks were printed by the previous process
             start_chunk = int(state["next_chunk"])
+            chunk = int(state["chunk"])
             weights = tuple(
                 jnp.asarray(w, dtype=dtype) for w in state["weights"]
             )
@@ -209,7 +221,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             stats = tuple(np.asarray(s) for s in stats)
             if state_path:
                 _save_fuse_state(
-                    state_path, conf.samples, conf.seed, ci + 1, weights)
+                    state_path, state_key, conf.seed, ci + 1, chunk, weights)
             for i in range(Xc.shape[0]):
                 if emit_header_only_until_readable() is None:
                     break
@@ -220,8 +232,6 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 _print_train_tokens(res, model, momentum)
         # trailing unreadable files still get their header lines
         emit_header_only_until_readable()
-        if state_path and os.path.exists(state_path):
-            os.remove(state_path)  # round completed
     else:
         # streaming path; reuse pre-parsed samples when a fused attempt
         # bailed (zero trainable samples — all entries None) rather
@@ -251,44 +261,55 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         )
     else:
         conf.kernel = kernel_mod.Kernel(tuple(np.asarray(w) for w in weights))
+    # round completed (any path): drop THIS round's checkpoint so the
+    # next round over the same samples can't mistake it for its own —
+    # unrelated checkpoints (different key) are left alone
+    if state_path and _load_fuse_state(state_path, state_key) is not None:
+        os.remove(state_path)
     return True
 
 
-def _fuse_state_key(sample_dir):
+def _fuse_state_key(sample_dir, model, momentum, shapes):
     """Round identity for crash-resume checkpoints: the sample dir's
-    file census (resume is only valid against the same directory)."""
+    file census plus the network identity (model/mode/topology), so a
+    checkpoint is never adopted by a different round over the same
+    samples (e.g. the MNIST ANN and SNN tutorials share a dir)."""
     import hashlib
 
     names = sample_io.list_sample_files(sample_dir)
-    return hashlib.sha256("\n".join(names).encode()).hexdigest()
+    ident = f"{model}/{momentum}/{shapes}"
+    return hashlib.sha256(
+        ("\n".join(names) + "\0" + ident).encode()
+    ).hexdigest()
 
 
-def _load_fuse_state(path, sample_dir):
+def _load_fuse_state(path, key):
     """Load a fused-round crash-resume checkpoint, or None when absent
-    or belonging to a different sample directory."""
+    or belonging to a different round identity."""
     if not path or not os.path.exists(path):
         return None
     try:
         z = np.load(path, allow_pickle=False)
-        if str(z["key"]) != _fuse_state_key(sample_dir):
+        if str(z["key"]) != key:
             return None
         n = int(z["n_layers"])
         return {
             "seed": int(z["seed"]),
             "next_chunk": int(z["next_chunk"]),
+            "chunk": int(z["chunk"]),
             "weights": tuple(z[f"w{i}"] for i in range(n)),
         }
     except Exception:
         return None  # unreadable/partial checkpoint: start over
 
 
-def _save_fuse_state(path, sample_dir, seed, next_chunk, weights):
+def _save_fuse_state(path, key, seed, next_chunk, chunk, weights):
     """Atomically checkpoint a fused round after a completed chunk."""
     tmp = path + ".tmp"
     arrs = {f"w{i}": np.asarray(w) for i, w in enumerate(weights)}
     np.savez(
-        tmp, key=_fuse_state_key(sample_dir), seed=seed,
-        next_chunk=next_chunk, n_layers=len(weights), **arrs,
+        tmp, key=key, seed=seed,
+        next_chunk=next_chunk, chunk=chunk, n_layers=len(weights), **arrs,
     )
     # np.savez appends .npz to names without it
     src = tmp if os.path.exists(tmp) else tmp + ".npz"

@@ -15,6 +15,7 @@
 #            instead of downloading; same container format, same
 #            pdif conversion, same pipeline
 set -u
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 N_ROUNDS=${N_ROUNDS:-10}
 BATCH_MODE=
 SYNTH_MODE=
@@ -77,14 +78,17 @@ BATCH_ARGS=
 # plateau by converging every sample individually instead).
 [ -n "$BATCH_MODE" ] && BATCH_ARGS="--batch ${BATCH_SIZE:-256} --epochs ${EPOCHS:-400} --lr ${BATCH_LR:-0.4}"
 
+. "$SCRIPT_DIR/../lib.sh"
+
 rm -f raw log results; touch raw log
-train_nn -v -v -v $BATCH_ARGS ./xrd.conf &> log
+train_round $BATCH_ARGS ./xrd.conf || { echo "training failed!"; exit 1; }
 run_nn -v -v ./cont_xrd.conf &> results
 N=$(grep -c 'TESTING' results || true)
 NRS=$(grep -c PASS results || true)
 echo "0 $NRS/$N" >> raw; tail -1 raw
 for IDX in $(seq 1 "$N_ROUNDS"); do
-    train_nn -v -v -v $BATCH_ARGS ./cont_xrd.conf &> log
+    rm -f log; touch log
+    train_round $BATCH_ARGS ./cont_xrd.conf || { echo "training failed!"; exit 1; }
     run_nn -v -v ./cont_xrd.conf &> results
     NRS=$(grep -c PASS results || true)
     echo "$IDX $NRS/$N" >> raw; tail -1 raw

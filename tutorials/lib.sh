@@ -1,0 +1,24 @@
+# Shared tutorial helpers (sourced by tutorials/*/tutorial.sh and
+# tutorials/mnist/opt_mnist.sh from their working directory).
+
+# train_round [args...]: one training round, appended to ./log.
+# Batch mode runs once (its dispatches are short).  Per-sample rounds
+# checkpoint per chunk (HPNN_FUSE_STATE) and retry on failure — the
+# tunneled TPU worker can crash mid-round and a fresh process resumes
+# from the checkpoint.  Gives up (status 1) after TRAIN_RETRIES
+# attempts so callers can abort instead of recording bogus rounds.
+train_round() {
+    if [ -n "$BATCH_MODE" ]; then
+        train_nn -v -v -v "$@" &>> log
+        return
+    fi
+    local tries=0
+    while [ $tries -lt "${TRAIN_RETRIES:-15}" ]; do
+        tries=$((tries+1))
+        HPNN_FUSE_STATE="$PWD/round.state" train_nn -v -v -v "$@" &>> log \
+            && return 0
+        echo "NN(WARN): training attempt $tries failed; resuming" >> log
+        sleep 5
+    done
+    return 1
+}

@@ -1,11 +1,12 @@
-# Shared round monitor for the MNIST tutorials — sourced by
+# Shared round helpers for the MNIST tutorials — sourced by
 # tutorial.sh and opt_mnist.sh (both count PASS from run_nn output and
 # the OPT numerator from the train log; the batch mode prints no
 # per-sample ' OK ', so the last BATCH EPOCH accuracy count stands in,
 # format: hpnn_tpu/train/batch.py BATCH EPOCH line).
 #
 # Expects: $BATCH_MODE, $N_TRAIN_FILES, $N_TEST_FILES, ./log, ./results
-# Appends "<round> <PASS%> <OPT%>" to ./raw and echoes it.
+# round_eval appends "<round> <PASS%> <OPT%>" to ./raw and echoes it.
+. "$SCRIPT_DIR/../lib.sh"
 round_eval() {
     NRS=$(grep -c PASS results || true)
     if [ -n "$BATCH_MODE" ]; then

@@ -43,11 +43,12 @@ rm -f raw log results; touch raw log
 N_TRAIN_FILES=$(ls samples | wc -l)
 N_TEST_FILES=$(ls tests | wc -l)
 . "$SCRIPT_DIR/monitor.sh"
-train_nn -v -v $BATCH_ARGS ./mnist_snn.conf &> log
+train_round $BATCH_ARGS ./mnist_snn.conf || { echo "training failed!"; exit 1; }
 run_nn -v -v -v -v ./cont_mnist_snn.conf &> results
 round_eval 1
 for IDX in $(seq 2 "$N_ROUNDS"); do
-    train_nn -v -v $BATCH_ARGS ./cont_mnist_snn.conf &> log
+    rm -f log; touch log
+    train_round $BATCH_ARGS ./cont_mnist_snn.conf || { echo "training failed!"; exit 1; }
     run_nn -v -v -v -v ./cont_mnist_snn.conf &> results
     round_eval "$IDX"
 done

@@ -94,11 +94,12 @@ N_TRAIN_FILES=$(ls samples | wc -l)
 N_TEST_FILES=$(ls tests | wc -l)
 . "$SCRIPT_DIR/monitor.sh"
 # first pass (generate + train + eval)
-train_nn -v -v -v $BATCH_ARGS ./mnist_ann.conf &> log
+train_round $BATCH_ARGS ./mnist_ann.conf || { echo "training failed!"; exit 1; }
 run_nn -v -v ./cont_mnist_ann.conf &> results
 round_eval 0
 for IDX in $(seq 1 "$N_ROUNDS"); do
-    train_nn -v -v -v $BATCH_ARGS ./cont_mnist_ann.conf &> log
+    rm -f log; touch log
+    train_round $BATCH_ARGS ./cont_mnist_ann.conf || { echo "training failed!"; exit 1; }
     run_nn -v -v ./cont_mnist_ann.conf &> results
     round_eval "$IDX"
 done
