@@ -68,6 +68,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     stream and resulting weights are identical to the single-device
     path (zero-padding to mesh multiples is a fixed point of the math,
     parallel/mesh.py)."""
+    import jax
     import jax.numpy as jnp
 
     if conf.kernel is None or conf.samples is None or conf.type == NNType.UKN:
@@ -165,31 +166,40 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         bank = _stack_epoch_bank(parsed, dtype)
     if bank is not None:
         # fused rounds: the shuffled samples scan on device in chunks
-        # of HPNN_FUSE_CHUNK (default 2048) with the weights carried
+        # of HPNN_FUSE_CHUNK (default 1024) with the weights carried
         # chunk to chunk — identical math and token stream to the
         # streaming path (tests/test_reference_parity.py), one dispatch
         # per chunk instead of per sample.  Chunking (a) bounds a
-        # single dispatch's run time — a whole-60k-round dispatch
-        # (~1.5 h) was observed to die with 'TPU worker process
-        # crashed' on the tunneled platform — and (b) streams the
-        # token output with progress instead of going silent for the
-        # full round.
+        # single dispatch's run time — the tunneled TPU worker kills
+        # dispatches past an execution budget (~100 s observed:
+        # 'TPU worker process crashed'), and late-round chunks run
+        # long because many samples burn the full 102 399-iteration
+        # cap — and (b) streams tokens with progress instead of going
+        # silent for the full round.
         X, T = bank
         # the token loop below only needs the readable mask — drop the
         # parsed host arrays (~hundreds of MB at 60k-sample scale)
         readable = [s is not None for s in parsed]
         parsed = bank = None
-        chunk = max(1, int(os.environ.get("HPNN_FUSE_CHUNK", "2048")))
-        start_chunk = 0
+        chunk = max(1, int(os.environ.get("HPNN_FUSE_CHUNK", "1024")))
+        done = 0  # samples already trained (and token-printed)
         if state is not None:
-            # resume: restore chunk-carried weights AND the original
-            # run's chunk size (a different HPNN_FUSE_CHUNK would skip
-            # the wrong number of samples); tokens for completed
-            # chunks were printed by the previous process
-            start_chunk = int(state["next_chunk"])
+            # resume: restore the chunk-carried weights, the absolute
+            # progress, and the chunk hint (halved by a prior crashed
+            # attempt — see the JaxRuntimeError handler)
+            done = int(state["done"])
             chunk = int(state["chunk"])
             weights = tuple(
                 jnp.asarray(w, dtype=dtype) for w in state["weights"]
+            )
+        # host copy of the last checkpointed weights: after a worker
+        # crash the device arrays are unreachable, so the crash handler
+        # can only checkpoint from here (only kept when checkpointing)
+        host_w = None
+        if state_path:
+            host_w = (
+                tuple(state["weights"]) if state is not None
+                else tuple(w.copy() for w in weights_np)
             )
         fname_it = iter(zip(files, readable))
 
@@ -204,24 +214,40 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                     return fname
             return None
 
-        for _ in range(start_chunk * chunk):  # resume: skip printed part
+        for _ in range(done):  # resume: skip the already-printed part
             if emit_header_only_until_readable(silent=True) is None:
                 break
-        for ci, c0 in enumerate(range(0, X.shape[0], chunk)):
-            if ci < start_chunk:
-                continue
-            Xc = jnp.asarray(X[c0 : c0 + chunk])
-            Tc = jnp.asarray(T[c0 : c0 + chunk])
-            weights, stats = loop.train_epoch_lax(
-                weights, dw0, Xc, Tc,
-                alpha, delta,
-                model=model, momentum=momentum,
-                min_iter=min_iter, max_iter=max_iter,
-            )
-            stats = tuple(np.asarray(s) for s in stats)
+        while done < X.shape[0]:
+            Xc = jnp.asarray(X[done : done + chunk])
+            Tc = jnp.asarray(T[done : done + chunk])
+            try:
+                weights, stats = loop.train_epoch_lax(
+                    weights, dw0, Xc, Tc,
+                    alpha, delta,
+                    model=model, momentum=momentum,
+                    min_iter=min_iter, max_iter=max_iter,
+                )
+                stats = tuple(np.asarray(s) for s in stats)
+            except jax.errors.JaxRuntimeError:
+                # worker killed mid-dispatch (likely the execution
+                # budget): leave a checkpoint telling the NEXT attempt
+                # to retry this chunk at half the size, then re-raise —
+                # the in-process runtime (and its device arrays) is
+                # unusable after the crash, hence the host copy
+                if state_path:
+                    # halve for the next attempt, but never above the
+                    # configured size and not below a 32-sample floor
+                    # (or the configured size, whichever is smaller)
+                    _save_fuse_state(
+                        state_path, state_key, conf.seed, done,
+                        max(min(32, chunk), chunk // 2), host_w,
+                    )
+                raise
+            done += int(Xc.shape[0])
             if state_path:
+                host_w = tuple(np.asarray(w) for w in weights)
                 _save_fuse_state(
-                    state_path, state_key, conf.seed, ci + 1, chunk, weights)
+                    state_path, state_key, conf.seed, done, chunk, host_w)
             for i in range(Xc.shape[0]):
                 if emit_header_only_until_readable() is None:
                     break
@@ -295,7 +321,7 @@ def _load_fuse_state(path, key):
         n = int(z["n_layers"])
         return {
             "seed": int(z["seed"]),
-            "next_chunk": int(z["next_chunk"]),
+            "done": int(z["done"]),
             "chunk": int(z["chunk"]),
             "weights": tuple(z[f"w{i}"] for i in range(n)),
         }
@@ -303,13 +329,15 @@ def _load_fuse_state(path, key):
         return None  # unreadable/partial checkpoint: start over
 
 
-def _save_fuse_state(path, key, seed, next_chunk, chunk, weights):
-    """Atomically checkpoint a fused round after a completed chunk."""
+def _save_fuse_state(path, key, seed, done, chunk, weights):
+    """Atomically checkpoint a fused round: ``done`` samples trained
+    (absolute — independent of any chunk-size change), ``chunk`` the
+    suggested dispatch size for the next attempt."""
     tmp = path + ".tmp"
     arrs = {f"w{i}": np.asarray(w) for i, w in enumerate(weights)}
     np.savez(
         tmp, key=key, seed=seed,
-        next_chunk=next_chunk, chunk=chunk, n_layers=len(weights), **arrs,
+        done=done, chunk=chunk, n_layers=len(weights), **arrs,
     )
     # np.savez appends .npz to names without it
     src = tmp if os.path.exists(tmp) else tmp + ".npz"

@@ -217,24 +217,34 @@ def test_fused_round_crash_resume(workdir, capsys, monkeypatch):
 
     state = workdir / "round.state"
     monkeypatch.setenv("HPNN_FUSE_EPOCH", "1")
-    monkeypatch.setenv("HPNN_FUSE_CHUNK", "6")
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "128")
     monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
-    # crash the TPU-worker way: die inside the SECOND chunk dispatch
+    # crash the TPU-worker way (the real failure raises
+    # jax.errors.JaxRuntimeError): die inside the FIRST chunk dispatch
+    # — the only possible checkpoint writer is then the crash handler
+    import jax
+
     real_epoch = loop.train_epoch_lax
     calls = {"n": 0}
 
     def dying_epoch(*a, **kw):
         calls["n"] += 1
-        if calls["n"] == 2:
-            raise RuntimeError("TPU worker process crashed (simulated)")
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError(
+                "UNAVAILABLE: TPU worker process crashed (simulated)")
         return real_epoch(*a, **kw)
 
     monkeypatch.setattr(loop, "train_epoch_lax", dying_epoch)
     conf = config.load_conf(conf_path)
-    with pytest.raises(RuntimeError):
+    with pytest.raises(jax.errors.JaxRuntimeError):
         driver.train_kernel(conf)
     part1 = capsys.readouterr().out
-    assert state.exists()  # checkpoint left behind after chunk 1
+    # handler checkpoint: zero progress, chunk hint HALVED (128 → 64),
+    # weights = the round's start state (host copy)
+    assert state.exists()
+    z = np.load(state, allow_pickle=False)
+    assert int(z["done"]) == 0
+    assert int(z["chunk"]) == 64
 
     # new "process": resume and finish the round
     monkeypatch.setattr(loop, "train_epoch_lax", real_epoch)
