@@ -86,31 +86,93 @@ def make_eval_fn(*, model: str):
     return ev
 
 
-def accuracy_counts(out: np.ndarray, T: np.ndarray, model: str) -> int:
-    """Vectorized argmax-vs-target, same rules as the per-sample eval
-    (train/driver.py: _first_argmax / _last_above quirks)."""
+def _count_correct(xp, out, T, model: str):
+    """Argmax-vs-target quirk rules, shared by the host
+    (:func:`accuracy_counts`, xp=numpy) and device
+    (:func:`make_device_count_fn`, xp=jax.numpy) counters so the
+    quirks can never drift between them."""
+    n_out = T.shape[1]
+    rev = T[:, ::-1]
     if model == "ann":
         # probe=-1 quirk (driver._first_argmax): if no output exceeds
         # -1.0 the guess stays out of range and can never PASS
-        guess = np.where(
-            out.max(axis=1) > -1.0, np.argmax(out, axis=1), out.shape[1]
+        guess = xp.where(
+            out.max(axis=1) > -1.0, xp.argmax(out, axis=1), n_out
         )
         above = T > 0.5
-        is_ok = np.where(
+        is_ok = xp.where(
             above.any(axis=1),
-            T.shape[1] - 1 - np.argmax(above[:, ::-1], axis=1),
+            n_out - 1 - xp.argmax(rev > 0.5, axis=1),
             1,  # C quirk: is_ok starts at TRUE==1 (ref: src/libhpnn.c:1443)
         )
     else:
         # SNN probe starts at 0 and keeps index 0 unless out > 0
-        guess = np.where((out > 0).any(axis=1), np.argmax(out, axis=1), 0)
+        guess = xp.where((out > 0).any(axis=1), xp.argmax(out, axis=1), 0)
         above = T > 0.1
-        is_ok = np.where(
+        is_ok = xp.where(
             above.any(axis=1),
-            T.shape[1] - 1 - np.argmax(above[:, ::-1], axis=1),
+            n_out - 1 - xp.argmax(rev > 0.1, axis=1),
             0,
         )
-    return int(np.sum(guess == is_ok))
+    return xp.sum(guess == is_ok)
+
+
+def make_device_count_fn(*, model: str):
+    """On-device twin of eval + :func:`accuracy_counts` (same quirks,
+    same HIGHEST-precision forward): count_fn(weights, X, T) -> int32
+    scalar of correct samples.  Lets whole multi-epoch training runs
+    stay on device — only per-epoch (loss, count) scalars come back."""
+    import jax
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import ann, snn
+
+    mod = snn if model == "snn" else ann
+
+    def count(weights, X, T):
+        with jax.default_matmul_precision("float32"):
+            out = jax.vmap(lambda x: mod.run(weights, x))(X)
+        return _count_correct(jnp, out, T, model).astype(jnp.int32)
+
+    return count
+
+
+def make_multi_epoch_fn(step_fn, count_fn):
+    """Many whole epochs in ONE dispatch: an outer ``lax.scan`` over
+    epochs (each an inner scan over minibatches gathered by index from
+    the on-device bank, then a bank-wide accuracy count).
+
+    run(weights, dw, X, T, idx[E, S, B]) ->
+        (weights, dw, losses[E, S], counts[E])
+
+    Single-data-shard only (the bank lives replicated on device); the
+    sharded-data-axis mode keeps its per-epoch host permute.
+    """
+    import jax
+    from jax import lax
+
+    def run(weights, dw, X, T, idx):
+        def epoch(carry, ix_e):
+            w, m = carry
+
+            def body(c, ix):
+                w2, m2 = c
+                w2, m2, l = step_fn(w2, m2, X[ix], T[ix])
+                return (w2, m2), l
+
+            (w, m), losses = lax.scan(body, (w, m), ix_e)
+            return (w, m), (losses, count_fn(w, X, T))
+
+        (weights, dw), (losses, counts) = lax.scan(epoch, (weights, dw), idx)
+        return weights, dw, losses, counts
+
+    return jax.jit(run)
+
+
+def accuracy_counts(out: np.ndarray, T: np.ndarray, model: str) -> int:
+    """Vectorized argmax-vs-target, same rules as the per-sample eval
+    (train/driver.py: _first_argmax / _last_above quirks)."""
+    return int(_count_correct(np, out, T, model))
 
 
 def train_kernel_batched(
@@ -194,18 +256,34 @@ def train_kernel_batched(
         and vmem_bytes <= 12 * 2**20
         and os.environ.get("HPNN_PALLAS", "1") != "0"
     )
-    if use_pallas:
-        from hpnn_tpu.ops import pallas_train
+    if gather:
+        # single data shard: fuse MANY epochs per dispatch — the inner
+        # step is the fused Pallas kernel or dp.train_step_math, the
+        # per-epoch eval+accuracy runs on device too, and only the
+        # per-epoch (losses, count) scalars come home
+        if lr is None:
+            lr = dp.default_lr(model, momentum)
+        if use_pallas:
+            from hpnn_tpu.ops import pallas_train
 
-        epoch_fn = pallas_train.make_pallas_epoch_fn(
-            weights, model=model, momentum=momentum, lr=lr, alpha=0.2,
-        )
+            def step_fn(w, m, Xb, Tb):
+                return pallas_train.train_step_fused_batch(
+                    w, m, Xb, Tb, model=model, momentum=momentum,
+                    lr=lr, alpha=0.2,
+                )
+        else:
+            def step_fn(w, m, Xb, Tb):
+                return dp.train_step_math(
+                    w, m, Xb, Tb, model=model, momentum=momentum,
+                    lr=lr, alpha=0.2,
+                )
+        multi_fn = make_multi_epoch_fn(step_fn, make_device_count_fn(model=model))
     else:
         epoch_fn = dp.make_gspmd_epoch_fn(
             mesh, weights, model=model, momentum=momentum, lr=lr, alpha=0.2,
             gather=gather,
         )
-    eval_fn = make_eval_fn(model=model)
+        eval_fn = make_eval_fn(model=model)  # host eval per epoch
 
     w_sh = dp.place_kernel(weights, mesh)
     dw_sh = dp.place_kernel(
@@ -242,26 +320,7 @@ def train_kernel_batched(
             "(n=%i, batch=%i)\n",
             pad, n, B,
         )
-    for epoch in range(1, epochs + 1):
-        order = rng.permutation(n)
-        # wrap the tail so every batch is full (static shapes for jit);
-        # np.resize repeats the permutation as needed even when B > 2n
-        if pad:
-            order = np.resize(order, n + pad)
-        n_steps = len(order) // B
-        if gather:
-            idx = jnp.asarray(order.reshape(n_steps, B), dtype=jnp.int32)
-            w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, X_dev, T_dev, idx)
-        else:
-            Xe = Xd[order].reshape(n_steps, B, -1)
-            Te = Td[order].reshape(n_steps, B, -1)
-            Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
-            w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
-        loss = float(jnp.mean(losses))
-        # gather mode: the bank already lives on device — don't
-        # re-upload ~n*dim*4 bytes per epoch just to eval
-        out = np.asarray(eval_fn(w_sh, X_dev if gather else jnp.asarray(Xd)))
-        okc = accuracy_counts(out, T, model)
+    def print_epoch(epoch, loss, okc):
         log.nn_out(
             sys.stdout,
             "BATCH EPOCH %4i loss= %.10f acc= %7.3f%% (%i/%i)\n",
@@ -272,6 +331,47 @@ def train_kernel_batched(
             n,
         )
         log.flush()
+
+    def epoch_order():
+        order = rng.permutation(n)
+        # wrap the tail so every batch is full (static shapes for jit);
+        # np.resize repeats the permutation as needed even when B > 2n
+        return np.resize(order, n + pad) if pad else order
+
+    n_steps = (n + pad) // B
+    if gather:
+        # cap the steps per dispatch (the tunneled worker kills very
+        # long dispatches); batch steps are fixed-cost, so the cap
+        # maps to a bounded run time
+        e_cap = max(1, 65536 // max(1, n_steps))
+        epoch = 0
+        while epoch < epochs:
+            e_block = min(e_cap, epochs - epoch)
+            idx = jnp.asarray(
+                np.stack([
+                    epoch_order().reshape(n_steps, B) for _ in range(e_block)
+                ]),
+                dtype=jnp.int32,
+            )
+            w_sh, dw_sh, losses, counts = multi_fn(
+                w_sh, dw_sh, X_dev, T_dev, idx)
+            losses = np.asarray(losses)
+            counts = np.asarray(counts)
+            for e in range(e_block):
+                epoch += 1
+                loss = float(losses[e].mean())
+                print_epoch(epoch, loss, int(counts[e]))
+    else:
+        for epoch in range(1, epochs + 1):
+            order = epoch_order()
+            Xe = Xd[order].reshape(n_steps, B, -1)
+            Te = Td[order].reshape(n_steps, B, -1)
+            Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
+            w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
+            loss = float(jnp.mean(losses))
+            out = np.asarray(eval_fn(w_sh, jnp.asarray(Xd)))
+            okc = accuracy_counts(out, T, model)
+            print_epoch(epoch, loss, okc)
     jax.block_until_ready(w_sh)
     conf.kernel = kernel_mod.Kernel(
         tuple(np.asarray(w, dtype=np.float64) for w in w_sh)

@@ -153,3 +153,69 @@ def test_cli_lr_requires_batch(tmp_path, capsys, monkeypatch):
     assert "requires --batch" in capsys.readouterr().err
     assert cli.main(["--batch", "8", "--lr", "bogus", "nn.conf"]) == -1
     assert "bad --lr" in capsys.readouterr().err
+
+
+def test_device_count_matches_accuracy_counts():
+    """On-device count (multi-epoch fused trainer) == the numpy
+    accuracy_counts quirks, including the no-positive-output and
+    no-hot-target edge cases, for both models."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    rng = np.random.RandomState(11)
+    k, _ = kernel_mod.generate(5, 6, [5], 4)
+    weights = tuple(jnp.asarray(np.asarray(w), jnp.float32) for w in k.weights)
+    for model in ("ann", "snn"):
+        lo = 0.0 if model == "snn" else -1.0
+        X = rng.uniform(-2, 2, (32, 6)).astype(np.float32)
+        T = np.full((32, 4), lo, dtype=np.float32)
+        hot = rng.randint(0, 4, 32)
+        T[np.arange(32), hot] = 1.0
+        T[0, :] = lo  # no hot target at all (is_ok quirk default)
+        ev = batch_mod.make_eval_fn(model=model)
+        out = np.asarray(ev(weights, jnp.asarray(X)))
+        want = batch_mod.accuracy_counts(out, T, model)
+        cf = batch_mod.make_device_count_fn(model=model)
+        got = int(cf(weights, jnp.asarray(X), jnp.asarray(T)))
+        assert got == want, (model, got, want)
+
+
+def test_multi_epoch_fn_matches_epoch_loop(tmp_path):
+    """The multi-epoch fused dispatch produces the same stream content
+    (per-epoch losses and counts) as epoch-by-epoch training."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.parallel import dp
+
+    rng = np.random.RandomState(2)
+    k, _ = kernel_mod.generate(9, 6, [5], 3)
+    weights = tuple(jnp.asarray(np.asarray(w), jnp.float32) for w in k.weights)
+    n, B, E = 24, 8, 3
+    X = jnp.asarray(rng.uniform(-1, 1, (n, 6)), jnp.float32)
+    T = np.full((n, 3), -1.0, dtype=np.float32)
+    T[np.arange(n), rng.randint(0, 3, n)] = 1.0
+    T = jnp.asarray(T)
+    idx = jnp.asarray(
+        np.stack([np.random.RandomState(s).permutation(n).reshape(-1, B)
+                  for s in range(E)]), jnp.int32)
+
+    def step_fn(w, m, Xb, Tb):
+        return dp.train_step_math(w, m, Xb, Tb, model="ann",
+                                  momentum=False, lr=0.05, alpha=0.2)
+
+    mf = batch_mod.make_multi_epoch_fn(
+        step_fn, batch_mod.make_device_count_fn(model="ann"))
+    w_all, _, losses, counts = mf(weights, (), X, T, idx)
+
+    w = weights
+    for e in range(E):
+        for s in range(idx.shape[1]):
+            w, _, l = step_fn(w, (), X[idx[e, s]], T[idx[e, s]])
+            np.testing.assert_allclose(float(l), float(losses[e, s]),
+                                       rtol=1e-5)
+        cf = batch_mod.make_device_count_fn(model="ann")
+        assert int(cf(w, X, T)) == int(counts[e])
+    for a, b in zip(w_all, w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -2,7 +2,9 @@
 # tutorials/mnist/opt_mnist.sh from their working directory).
 
 # train_round [args...]: one training round, appended to ./log.
-# Batch mode runs once (its dispatches are short).  Per-sample rounds
+# Batch mode runs once, WITHOUT the timeout/retry machinery — its
+# rounds have no resume checkpoint, so killing one would restart it
+# from epoch 1 (and its dispatches are short anyway).  Per-sample rounds
 # checkpoint per chunk (HPNN_FUSE_STATE) and retry on failure — the
 # tunneled TPU worker can crash mid-round and a fresh process resumes
 # from the checkpoint.  Gives up (status 1) after TRAIN_RETRIES
@@ -15,8 +17,12 @@ train_round() {
     local tries=0
     while [ $tries -lt "${TRAIN_RETRIES:-15}" ]; do
         tries=$((tries+1))
-        HPNN_FUSE_STATE="$PWD/round.state" train_nn -v -v -v "$@" &>> log \
-            && return 0
+        # the tunneled worker sometimes HANGS a dispatch instead of
+        # raising — a per-attempt timeout turns that into a retry that
+        # resumes from the chunk checkpoint
+        HPNN_FUSE_STATE="$PWD/round.state" \
+            timeout -k 15 "${TRAIN_TIMEOUT:-900}" train_nn -v -v -v "$@" \
+            &>> log && return 0
         echo "NN(WARN): training attempt $tries failed; resuming" >> log
         sleep 5
     done
