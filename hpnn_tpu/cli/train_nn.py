@@ -49,11 +49,19 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
-    try:
-        with open("kernel.tmp", "w") as fp:
-            config.dump_kernel(conf, fp)
-    except OSError:
-        sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
+    # multi-process: rank 0 alone writes the kernel files, like the
+    # reference's rank-0 ann_dump + barrier (ref: src/ann.c:787-856) —
+    # every rank sharing a cwd must not race on the same path.  The
+    # write outcome is synced so peers never proceed into collective
+    # training while rank 0 aborts.
+    from hpnn_tpu.parallel import dist
+
+    rank0 = runtime.process_index() == 0
+    if not dist.sync_rank0_ok(
+        _dump_kernel_file(conf, "kernel.tmp") if rank0 else True
+    ):
+        if rank0:
+            sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
         runtime.deinit_all()
         return -1
     with common.profile_trace(opts.get("profile")):
@@ -73,15 +81,24 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to train kernel!\n")
         runtime.deinit_all()
         return -1
-    try:
-        with open("kernel.opt", "w") as fp:
-            config.dump_kernel(conf, fp)
-    except OSError:
-        sys.stderr.write("FAILED to open kernel.opt for WRITE!\n")
+    if not dist.sync_rank0_ok(
+        _dump_kernel_file(conf, "kernel.opt") if rank0 else True
+    ):
+        if rank0:
+            sys.stderr.write("FAILED to open kernel.opt for WRITE!\n")
         runtime.deinit_all()
         return -1
     runtime.deinit_all()
     return 0
+
+
+def _dump_kernel_file(conf, path: str) -> bool:
+    try:
+        with open(path, "w") as fp:
+            config.dump_kernel(conf, fp)
+        return True
+    except OSError:
+        return False
 
 
 if __name__ == "__main__":

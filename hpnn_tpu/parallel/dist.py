@@ -67,6 +67,47 @@ def hybrid_mesh(n_model: int = 1, devices=None):
     return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
 
 
+def census_consistent(names) -> bool:
+    """Multi-process guard: every rank must hold the SAME sample files
+    in the SAME row order, or the per-rank shards of a "global" batch
+    array would silently come from differently-ordered banks.
+
+    The reference makes the identical assumption implicitly (every MPI
+    rank scans the dir itself and replays the same seeded shuffle,
+    ref: /root/reference/src/libhpnn.c:1218-1229) — readdir order is
+    not guaranteed across filesystems, so here it is *checked*: ranks
+    all-gather a census hash and every rank reaches the same verdict
+    (no rank is left behind in a collective on mismatch).  True
+    single-process."""
+    import hashlib
+
+    import jax
+
+    if jax.process_count() < 2:
+        return True
+    from jax.experimental import multihost_utils
+
+    digest = hashlib.sha256("\n".join(names).encode()).digest()[:8]
+    mine = np.frombuffer(digest, dtype=np.int64)
+    every = np.asarray(multihost_utils.process_allgather(mine))
+    return bool((every == every[0]).all())
+
+
+def sync_rank0_ok(ok: bool) -> bool:
+    """Broadcast a rank-0 outcome so every rank takes the same branch
+    (e.g. rank 0's kernel-file write: peers must not proceed into
+    collective training while rank 0 aborts).  The distributed twin of
+    the reference's load-time bail-out protocol (ref: src/ann.c:
+    242-248)."""
+    import jax
+
+    if jax.process_count() < 2:
+        return ok
+    from jax.experimental import multihost_utils
+
+    return bool(multihost_utils.broadcast_one_to_all(np.int32(1 if ok else 0)))
+
+
 def process_summary() -> str:
     """One-line cluster summary for logs (rank, #procs, local devices)."""
     import jax

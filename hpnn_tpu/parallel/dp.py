@@ -25,13 +25,52 @@ Two implementations, same math:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from hpnn_tpu.models import ann, snn
 from hpnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def global_put(arr, sharding):
+    """Multi-process-safe ``device_put``: build a global array from the
+    same host-global value on every process, each process materializing
+    only its addressable shards.
+
+    ``jax.device_put`` of a host array is the single-process API — under
+    ``JAX_NUM_PROCESSES>1`` it cannot address the remote shards of a
+    cross-process sharding.  ``jax.make_array_from_callback`` is the
+    general form (the reference's analogue is every rank holding the
+    same host data after ``MPI_Bcast`` and indexing out its row block,
+    ref: /root/reference/src/ann.c:557-615); it degrades to a plain
+    transfer single-process, so every placement below routes through it.
+    """
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_fn(sharding):
+    # one jitted identity per target sharding — a fresh lambda per call
+    # would re-trace/re-compile the gather every time
+    return jax.jit(lambda a: a, out_shardings=sharding)
+
+
+def host_fetch(x, mesh):
+    """Fetch a (possibly cross-process-sharded) array to every host.
+
+    Fully-addressable arrays convert directly; otherwise a jitted
+    identity with a replicated out-sharding performs the all-gather
+    (the reference's G2C + ``MPI_Allgather`` before ``ann_dump``,
+    ref: src/ann.c:787-856)."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    return np.asarray(_gather_fn(NamedSharding(mesh, P()))(x))
 
 
 def sample_loss(weights, x, target, *, model: str = "ann"):
@@ -170,11 +209,9 @@ def auto_kernel_shardings(mesh, weights):
 
 
 def place_kernel(weights, mesh):
-    """device_put every layer under its auto sharding."""
+    """Place every layer under its auto sharding (multi-process safe)."""
     shs = auto_kernel_shardings(mesh, weights)
-    return tuple(
-        jax.device_put(jnp.asarray(w), s) for w, s in zip(weights, shs)
-    )
+    return tuple(global_put(w, s) for w, s in zip(weights, shs))
 
 
 def train_step_math(weights, dw, X, T, *, model: str, momentum: bool,
@@ -285,20 +322,21 @@ def make_gspmd_epoch_fn(mesh, weights, *, model: str = "ann",
 
 
 def shard_batch(X, T, mesh):
-    """Place a (B, n) batch with B on the data axis."""
+    """Place a (B, n) batch with B on the data axis.
+
+    Every process passes the same host-global batch; each device takes
+    its row block via the shard callback, so this works unmodified
+    under ``JAX_NUM_PROCESSES>1``."""
     sh = NamedSharding(mesh, P(DATA_AXIS, None))
-    return jax.device_put(jnp.asarray(X), sh), jax.device_put(jnp.asarray(T), sh)
+    return global_put(X, sh), global_put(T, sh)
 
 
 def shard_batch_steps(Xs, Ts, mesh):
     """Place (n_steps, B, n) epoch batches with B on the data axis."""
     sh = NamedSharding(mesh, P(None, DATA_AXIS, None))
-    return (
-        jax.device_put(jnp.asarray(Xs), sh),
-        jax.device_put(jnp.asarray(Ts), sh),
-    )
+    return global_put(Xs, sh), global_put(Ts, sh)
 
 
 def replicate_kernel(weights, mesh):
     rep = NamedSharding(mesh, P())
-    return tuple(jax.device_put(jnp.asarray(w), rep) for w in weights)
+    return tuple(global_put(w, rep) for w in weights)

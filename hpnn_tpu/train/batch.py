@@ -64,26 +64,52 @@ def _model_of(conf: NNConf) -> str:
     return "snn" if conf.type in (NNType.SNN, NNType.LNN) else "ann"
 
 
-def make_eval_fn(*, model: str):
+def _resolve_seed(conf: NNConf) -> None:
+    """``[seed] 0`` means "time-seeded" like the reference's
+    ``srandom(time(NULL))``.  Multi-process: every rank must draw the
+    SAME epoch permutations (the reference relies on the conf seed for
+    this, ref: src/libhpnn.c:1218-1229), so rank 0's clock is broadcast
+    — two ranks straddling a second boundary would otherwise shuffle
+    differently and train on inconsistent global batches."""
+    if conf.seed != 0:
+        return
+    import time
+
+    import jax
+
+    seed = int(time.time())
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        seed = int(multihost_utils.broadcast_one_to_all(np.int64(seed)))
+    conf.seed = seed
+
+
+def make_eval_fn(*, model: str, out_sharding=None):
     """Jitted vmapped forward over a batch of inputs.
 
     Matmul precision is pinned to HIGHEST: the vmapped forward lowers
     to MXU matmuls which default to bf16-truncated inputs on TPU,
     while the per-sample M=1 matvec path stays full f32 on the VPU —
     without the pin the two eval streams would disagree on near-tie
-    argmaxes and on SNN's printed probabilities."""
+    argmaxes and on SNN's printed probabilities.
+
+    ``out_sharding``: pass a replicated NamedSharding when the weights
+    are (possibly cross-process) mesh-sharded, so the host count always
+    sees every output row."""
     import jax
 
     from hpnn_tpu.models import ann, snn
 
     mod = snn if model == "snn" else ann
 
-    @jax.jit
     def ev(weights, X):
         with jax.default_matmul_precision("float32"):
             return jax.vmap(lambda x: mod.run(weights, x))(X)
 
-    return ev
+    if out_sharding is not None:
+        return jax.jit(ev, out_shardings=out_sharding)
+    return jax.jit(ev)
 
 
 def _count_correct(xp, out, T, model: str):
@@ -198,11 +224,23 @@ def train_kernel_batched(
         return False
     if conf.train not in (NNTrain.BP, NNTrain.BPM):
         return True  # CG/SPLX parse but are unimplemented (reference parity)
-    if not os.path.isdir(conf.samples):
+    # the census collective must run on EVERY rank before any
+    # filesystem-dependent early return, or a rank whose dir is
+    # missing/empty would exit while its peers block in the gather
+    have_dir = os.path.isdir(conf.samples)
+    names, X, T = sample_io.read_dir(conf.samples) if have_dir else ([], None, None)
+    from hpnn_tpu.parallel import dist
+
+    if not dist.census_consistent(names):
+        log.nn_error(
+            sys.stderr,
+            "sample dir %s differs across processes (count or order)!\n",
+            conf.samples,
+        )
+        return False
+    if not have_dir:
         log.nn_error(sys.stderr, "can't open sample directory: %s\n", conf.samples)
         return False
-
-    names, X, T = sample_io.read_dir(conf.samples)
     n = len(names)
     if n == 0:
         log.nn_error(sys.stderr, "no samples in %s\n", conf.samples)
@@ -256,6 +294,9 @@ def train_kernel_batched(
         and vmem_bytes <= 12 * 2**20
         and os.environ.get("HPNN_PALLAS", "1") != "0"
     )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
     if gather:
         # single data shard: fuse MANY epochs per dispatch — the inner
         # step is the fused Pallas kernel or dp.train_step_math, the
@@ -283,7 +324,7 @@ def train_kernel_batched(
             mesh, weights, model=model, momentum=momentum, lr=lr, alpha=0.2,
             gather=gather,
         )
-        eval_fn = make_eval_fn(model=model)  # host eval per epoch
+        eval_fn = make_eval_fn(model=model, out_sharding=rep)
 
     w_sh = dp.place_kernel(weights, mesh)
     dw_sh = dp.place_kernel(
@@ -297,15 +338,13 @@ def train_kernel_batched(
     Xd = X.astype(dtype)
     Td = T.astype(dtype)
     if gather:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        rep = NamedSharding(mesh, P())
-        X_dev = jax.device_put(jnp.asarray(Xd), rep)
-        T_dev = jax.device_put(jnp.asarray(Td), rep)
-    if conf.seed == 0:  # 0 means "random", like the reference's srandom
-        import time
-
-        conf.seed = int(time.time())
+        X_dev = dp.global_put(Xd, rep)
+        T_dev = dp.global_put(Td, rep)
+    else:
+        # eval bank, placed once (replicated) instead of re-uploaded
+        # per epoch
+        X_eval = dp.global_put(Xd, rep)
+    _resolve_seed(conf)
     rng = np.random.RandomState(conf.seed & 0x7FFFFFFF)
     loss = float("nan")
     pad = (-n) % B
@@ -347,16 +386,16 @@ def train_kernel_batched(
         epoch = 0
         while epoch < epochs:
             e_block = min(e_cap, epochs - epoch)
-            idx = jnp.asarray(
+            idx = dp.global_put(
                 np.stack([
                     epoch_order().reshape(n_steps, B) for _ in range(e_block)
-                ]),
-                dtype=jnp.int32,
+                ]).astype(np.int32),
+                rep,
             )
             w_sh, dw_sh, losses, counts = multi_fn(
                 w_sh, dw_sh, X_dev, T_dev, idx)
-            losses = np.asarray(losses)
-            counts = np.asarray(counts)
+            losses = dp.host_fetch(losses, mesh)
+            counts = dp.host_fetch(counts, mesh)
             for e in range(e_block):
                 epoch += 1
                 loss = float(losses[e].mean())
@@ -369,12 +408,12 @@ def train_kernel_batched(
             Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
             w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
             loss = float(jnp.mean(losses))
-            out = np.asarray(eval_fn(w_sh, jnp.asarray(Xd)))
+            out = np.asarray(eval_fn(w_sh, X_eval))
             okc = accuracy_counts(out, T, model)
             print_epoch(epoch, loss, okc)
     jax.block_until_ready(w_sh)
     conf.kernel = kernel_mod.Kernel(
-        tuple(np.asarray(w, dtype=np.float64) for w in w_sh)
+        tuple(dp.host_fetch(w, mesh).astype(np.float64) for w in w_sh)
     )
     return True
 
@@ -390,10 +429,22 @@ def run_kernel_batched(conf: NNConf) -> None:
 
     if conf.kernel is None or conf.tests is None or conf.type == NNType.UKN:
         return
-    if not os.path.isdir(conf.tests):
+    # census collective before any filesystem-dependent early return
+    # (see train_kernel_batched)
+    have_dir = os.path.isdir(conf.tests)
+    names, X, T = sample_io.read_dir(conf.tests) if have_dir else ([], None, None)
+    from hpnn_tpu.parallel import dist
+
+    if not dist.census_consistent(names):
+        log.nn_error(
+            sys.stderr,
+            "test dir %s differs across processes (count or order)!\n",
+            conf.tests,
+        )
+        return
+    if not have_dir:
         log.nn_error(sys.stderr, "can't open test directory: %s\n", conf.tests)
         return
-    names, X, T = sample_io.read_dir(conf.tests)
     if not names:
         return
     dtype = _compute_dtype()
@@ -411,10 +462,7 @@ def run_kernel_batched(conf: NNConf) -> None:
     from hpnn_tpu.train.driver import print_verdict
     from hpnn_tpu.utils.glibc_random import shuffled_order
 
-    if conf.seed == 0:  # 0 means "time-seeded", like the reference
-        import time
-
-        conf.seed = int(time.time())
+    _resolve_seed(conf)
     row_of = {name: i for i, name in enumerate(names)}
     all_files = sample_io.list_sample_files(conf.tests)
     for idx in shuffled_order(conf.seed, len(all_files)):

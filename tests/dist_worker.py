@@ -42,19 +42,15 @@ def main() -> int:
                                     momentum=False)
     w_sh = dp.place_kernel(weights, mesh)
 
-    # the same global batch on every process; each device picks out its
-    # shard via the index callback (the multi-process twin of
-    # dp.shard_batch, which device_puts the whole batch single-process)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    # the same global batch on every process; dp.shard_batch places it
+    # multi-process-safely (each device takes its row block via the
+    # shard callback)
     B = 2 * n_data
     rng = np.random.RandomState(0)
     X = rng.uniform(-1, 1, (B, 6))
     T = np.full((B, 3), -1.0)
     T[np.arange(B), rng.randint(0, 3, B)] = 1.0
-    b_sh = NamedSharding(mesh, P(mesh_mod.DATA_AXIS, None))
-    Xs = jax.make_array_from_callback(X.shape, b_sh, lambda idx: X[idx])
-    Ts = jax.make_array_from_callback(T.shape, b_sh, lambda idx: T[idx])
+    Xs, Ts = dp.shard_batch(X, T, mesh)
 
     w_sh, _, loss = step(w_sh, (), Xs, Ts)
     jax.block_until_ready(loss)

@@ -101,3 +101,151 @@ def test_two_process_cluster(tmp_path):
     assert "NN: DIST STEP loss= " in outs[0]
     assert "tasks=2" in outs[0]
     assert "DIST STEP" not in outs[1]
+
+
+# --------------------------------------------------------------------------
+# The flagship multi-process mode: the UNMODIFIED CLIs under
+# JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES — the reference's
+# `mpirun -np 2 train_nn file.conf` (every rank enters main, loads the
+# conf, trains; rank 0 alone writes files and prints,
+# ref: /root/reference/src/libhpnn.c:182-200, src/ann.c:557-615).
+
+
+def _write_sample(path, x, t):
+    with open(path, "w") as fp:
+        fp.write(f"[input] {len(x)}\n")
+        fp.write(" ".join("%7.5f" % v for v in x) + "\n")
+        fp.write(f"[output] {len(t)}\n")
+        fp.write(" ".join("%.1f" % v for v in t) + "\n")
+
+
+def _make_workdir(root, name):
+    """A self-contained conf + 20-sample two-class dir (same content
+    every call, so separate workdirs are comparable)."""
+    work = root / name
+    samples = work / "samples"
+    samples.mkdir(parents=True)
+    rng = np.random.RandomState(42)
+    centers = np.array([[1.0] * 4 + [-1.0] * 4, [-1.0] * 4 + [1.0] * 4])
+    for i in range(20):
+        c = i % 2
+        x = centers[c] + 0.1 * rng.standard_normal(8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        _write_sample(samples / f"s{i:05d}.txt", x, t)
+    (work / "nn.conf").write_text(
+        "[name] MP\n[type] ANN\n[init] generate\n[seed] 1234\n"
+        "[input] 8\n[hidden] 6\n[output] 2\n[train] BP\n"
+        "[sample_dir] ./samples\n[test_dir] ./samples\n"
+    )
+    return work
+
+
+def _clean_env(n_local_devices):
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("JAX_", "XLA_", "PALLAS_", "AXON_", "TPU_"))
+        and k != "PYTHONPATH"
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_local_devices}"
+    )
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    return env
+
+
+def _tokens(stdout: str) -> str:
+    """The framework's stdout protocol only — the distributed backend's
+    own banners (e.g. `[Gloo] Rank 0 is connected ...`) are not part of
+    the grep-able token stream."""
+    return "".join(
+        ln for ln in stdout.splitlines(keepends=True)
+        if not ln.startswith("[Gloo]")
+    )
+
+
+def _run_cli(module, args, cwd, env):
+    p = subprocess.run(
+        [sys.executable, "-m", module] + args,
+        env=env,
+        cwd=str(cwd),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert p.returncode == 0, f"{module} failed:\n{p.stdout}\n{p.stderr}"
+    return p.stdout
+
+
+def _run_cli_cluster(module, args, cwd, nproc=2):
+    """Spawn `nproc` OS processes all running the same CLI invocation
+    (each with ONE local CPU device, `nproc` global)."""
+    port = _free_port()
+    procs = []
+    for rank in range(nproc):
+        env = _clean_env(1)
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = str(nproc)
+        env["JAX_PROCESS_ID"] = str(rank)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", module] + args,
+                env=env,
+                cwd=str(cwd),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for rank, p in enumerate(procs):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}\n{err}"
+            outs.append(out)
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+    return outs
+
+
+def test_two_process_train_nn_cli(tmp_path):
+    """`train_nn --batch` runs UNMODIFIED as a 2-process cluster over a
+    real sample dir and produces (on rank 0 only) the same token stream
+    and byte-identical kernel.tmp/kernel.opt as a single-process run
+    over the same 2-device global mesh."""
+    single = _make_workdir(tmp_path, "single")
+    multi = _make_workdir(tmp_path, "multi")
+    args = ["-v", "-v", "--batch", "4", "--epochs", "5", "--lr", "0.1",
+            "nn.conf"]
+
+    out_single = _run_cli("hpnn_tpu.cli.train_nn", args, single, _clean_env(2))
+    outs = _run_cli_cluster("hpnn_tpu.cli.train_nn", args, multi)
+
+    # same global mesh (2 devices, data axis) → same SPMD program →
+    # identical epoch tokens and identical %17.15f weight dumps
+    assert "NN: BATCH EPOCH" in out_single
+    assert _tokens(outs[0]) == _tokens(out_single)
+    # rank-0-only: the non-coordinator prints no tokens
+    assert "BATCH EPOCH" not in outs[1]
+    # rank 0 alone writes the kernel files (ref rank-0 ann_dump)
+    assert (multi / "kernel.opt").read_text() == (
+        single / "kernel.opt").read_text()
+    assert (multi / "kernel.tmp").read_text() == (
+        single / "kernel.tmp").read_text()
+
+    # eval: run_nn --batch under the same 2-process cluster
+    for work in (single, multi):
+        (work / "cont.conf").write_text(
+            (work / "nn.conf").read_text().replace(
+                "[init] generate", "[init] kernel.opt")
+        )
+    ev_args = ["-v", "-v", "--batch", "cont.conf"]
+    ev_single = _run_cli("hpnn_tpu.cli.run_nn", ev_args, single, _clean_env(2))
+    ev_outs = _run_cli_cluster("hpnn_tpu.cli.run_nn", ev_args, multi)
+    assert "TESTING FILE" in ev_single and "[PASS]" in ev_single
+    assert _tokens(ev_outs[0]) == _tokens(ev_single)
+    assert "TESTING FILE" not in ev_outs[1]
