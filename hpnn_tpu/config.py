@@ -252,7 +252,13 @@ def generate_kernel(conf: NNConf, n_in: int, hiddens: list[int], n_out: int) -> 
     (ref: src/libhpnn.c:975-980)."""
     if conf.type not in (NNType.ANN, NNType.SNN):
         return False
-    k, seed = kernel_mod.generate(conf.seed, n_in, hiddens, n_out)
+    # seed 0 materializes HERE (the earliest site): broadcast rank 0's
+    # clock under multi-process so every rank generates the same kernel
+    from hpnn_tpu.parallel import dist
+
+    k, seed = kernel_mod.generate(
+        dist.resolve_time_seed(conf.seed), n_in, hiddens, n_out
+    )
     conf.seed = seed
     conf.kernel = k
     conf.kernel_name = None  # generated kernels are unnamed (ref parity)

@@ -67,6 +67,32 @@ def hybrid_mesh(n_model: int = 1, devices=None):
     return Mesh(dev, (DATA_AXIS, MODEL_AXIS))
 
 
+def resolve_time_seed(seed: int) -> int:
+    """Materialize a ``[seed] 0`` ("time-seeded", the reference's
+    ``srandom(time(NULL))``) seed ONCE, multi-process-safely.
+
+    Every rank must generate the same kernel and replay the same
+    shuffles, so rank 0's clock is broadcast — two ranks loading the
+    conf across a second boundary would otherwise build different
+    initial kernels and orders, and the per-rank shards of a "global"
+    array would silently mix them.  Must be called wherever seed 0 is
+    first turned into a real seed (kernel generation at conf load is
+    the earliest site).  Identity for nonzero seeds and single-process
+    time-seeding."""
+    if seed != 0:
+        return seed
+    import time
+
+    import jax
+
+    s = int(time.time())
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        s = int(multihost_utils.broadcast_one_to_all(np.int64(s)))
+    return s
+
+
 def census_consistent(names) -> bool:
     """Multi-process guard: every rank must hold the SAME sample files
     in the SAME row order, or the per-rank shards of a "global" batch

@@ -211,6 +211,68 @@ def make_train_fn(
     return jax.jit(sharded)
 
 
+def make_train_epoch_fn(
+    mesh,
+    n_layers: int,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int,
+    max_iter: int,
+    n_out: int,
+):
+    """A whole fused round of the TP per-sample protocol in ONE
+    dispatch: ``lax.scan`` over the (shuffled) samples INSIDE the
+    ``shard_map``, each step the full sharded convergence loop with the
+    row-sharded weights carried sample to sample.
+
+    The TP twin of ``loop.train_epoch_lax`` — without it the mesh mode
+    pays one host dispatch per sample (~65-80 ms on a tunneled chip),
+    three orders slower than the fused single-device path at
+    60k-protocol scale.  The reference's MPI mode IS this protocol
+    distributed (ref: /root/reference/src/ann.c:912-936), so the
+    fused-by-default behavior must match it mode for mode.
+
+    ``X``: (n, n_in) replicated; ``T``: (n, pad_out) replicated
+    (targets zero-padded to the padded output rows).  Momentum raz
+    quirk as in the single-device scan: every sample restarts from
+    ``dw0`` (ref: src/ann.c:1921-1938).
+
+    Returns ``(weights, stats)``, stats the per-sample
+    ``(ep0, n_iter, dep, first_ok, final_ok)`` arrays.
+    """
+    k = mesh.shape[MODEL_AXIS]
+    wspec = kernel_specs(n_layers)
+    dspec = wspec if momentum else ()
+    mat = P(None, None)
+    scal = P()
+    vec = P(None)
+
+    def epoch(weights_loc, dw0_loc, X, T, alpha, delta):
+        def body(w, xt):
+            x, t = xt
+            res = train_sample_local(
+                w, dw0_loc, x, t, alpha, delta,
+                model=model, momentum=momentum,
+                min_iter=min_iter, max_iter=max_iter,
+                n_out=n_out, k=k,
+            )
+            return res.weights, (
+                res.ep0, res.n_iter, res.dep, res.first_ok, res.final_ok
+            )
+
+        return lax.scan(body, weights_loc, (X, T))
+
+    sharded = jax.shard_map(
+        epoch,
+        mesh=mesh,
+        in_specs=(wspec, dspec, mat, mat, scal, scal),
+        out_specs=(wspec, (vec, vec, vec, vec, vec)),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def make_run_fn(mesh, n_layers: int, *, model: str = "ann", n_out: int):
     """Jitted TP forward pass (``ann/snn_kernel_run`` over the mesh)."""
     wspec = kernel_specs(n_layers)

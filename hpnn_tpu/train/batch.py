@@ -65,24 +65,13 @@ def _model_of(conf: NNConf) -> str:
 
 
 def _resolve_seed(conf: NNConf) -> None:
-    """``[seed] 0`` means "time-seeded" like the reference's
-    ``srandom(time(NULL))``.  Multi-process: every rank must draw the
-    SAME epoch permutations (the reference relies on the conf seed for
-    this, ref: src/libhpnn.c:1218-1229), so rank 0's clock is broadcast
-    — two ranks straddling a second boundary would otherwise shuffle
-    differently and train on inconsistent global batches."""
-    if conf.seed != 0:
-        return
-    import time
+    """Materialize a ``[seed] 0`` conf seed (rank-0 clock broadcast —
+    see dist.resolve_time_seed; the shuffle replay depends on it,
+    ref: src/libhpnn.c:1218-1229).  Usually a no-op: ``[init] generate``
+    confs already materialized the seed at conf load."""
+    from hpnn_tpu.parallel import dist
 
-    import jax
-
-    seed = int(time.time())
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        seed = int(multihost_utils.broadcast_one_to_all(np.int64(seed)))
-    conf.seed = seed
+    conf.seed = dist.resolve_time_seed(conf.seed)
 
 
 def make_eval_fn(*, model: str, out_sharding=None):

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -99,7 +98,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         alpha=alpha, delta=delta,
     )
     if tp_state is not None:
-        weights, dw0, train_one = tp_state
+        weights, dw0, train_one, train_epoch = tp_state
     else:
         weights = tuple(jnp.asarray(w) for w in weights_np)
         dw0 = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
@@ -110,6 +109,15 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 jnp.asarray(x_np, dtype=dtype),
                 jnp.asarray(t_np, dtype=dtype),
                 alpha, delta,
+                model=model, momentum=momentum,
+                min_iter=min_iter, max_iter=max_iter,
+            )
+
+        def train_epoch(w, m0, Xc, Tc):
+            # looked up through the module so tests can monkeypatch
+            # loop.train_epoch_lax (crash simulation)
+            return loop.train_epoch_lax(
+                w, m0, jnp.asarray(Xc), jnp.asarray(Tc), alpha, delta,
                 model=model, momentum=momentum,
                 min_iter=min_iter, max_iter=max_iter,
             )
@@ -134,31 +142,35 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     state_key = None
     state = None
     if state_path:
+        # key over the TRAINING weight shapes (padded for TP), so a
+        # checkpoint from a different mesh layout is never adopted
         state_key = _fuse_state_key(
             conf.samples, model, momentum,
-            tuple(w.shape for w in weights_np),
+            tuple(tuple(int(d) for d in w.shape) for w in weights),
         )
         state = _load_fuse_state(state_path, state_key)
         if state is not None and conf.seed not in (0, int(state["seed"])):
             state = None  # different seeded round requested: start over
     if state is not None:
         conf.seed = int(state["seed"])
-    elif conf.seed == 0:
-        conf.seed = int(time.time())
+    else:
+        from hpnn_tpu.parallel import dist
+
+        conf.seed = dist.resolve_time_seed(conf.seed)
     files = list(_shuffled_files(conf.samples, conf.seed))
     # expected sample dims; a mismatched file is skipped with a warning
     # in both paths (the reference reads it into out-of-bounds C memory
     # — undefined behavior with nothing to be faithful to)
     exp_dims = (weights_np[0].shape[-1], weights_np[-1].shape[0])
-    # fused rounds don't apply to the TP path (the scan body would need
-    # the shard_map trainer) nor when the per-sample Pallas study is
-    # explicitly requested (HPNN_PALLAS=1 dispatches the Mosaic kernel
-    # from the streaming loop — fusing would silently bypass it)
+    # fused rounds apply to the single-device AND the TP path (the TP
+    # scan body is the shard_map trainer, tp.make_train_epoch_fn);
+    # excluded only when the per-sample Pallas study is explicitly
+    # requested (HPNN_PALLAS=1 dispatches the Mosaic kernel from the
+    # streaming loop — fusing would silently bypass it)
     parsed = bank = None
     if (
-        tp_state is None
-        and os.environ.get("HPNN_FUSE_EPOCH", "1") != "0"
-        and not loop._pallas_eligible(weights)
+        os.environ.get("HPNN_FUSE_EPOCH", "1") != "0"
+        and (tp_state is not None or not loop._pallas_eligible(weights))
     ):
         parsed = [
             _checked_sample(conf.samples, f, exp_dims) for f in files
@@ -189,9 +201,17 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             # attempt — see the JaxRuntimeError handler)
             done = int(state["done"])
             chunk = int(state["chunk"])
-            weights = tuple(
+            restored = tuple(
                 jnp.asarray(w, dtype=dtype) for w in state["weights"]
             )
+            if tp_state is not None:
+                # TP checkpoints hold the padded host weights;
+                # re-shard them on the model axis
+                from hpnn_tpu.parallel import tp
+
+                weights = tp.shard_kernel(restored, mesh)
+            else:
+                weights = restored
         # host copy of the last checkpointed weights: after a worker
         # crash the device arrays are unreachable, so the crash handler
         # can only checkpoint from here (only kept when checkpointing)
@@ -199,7 +219,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         if state_path:
             host_w = (
                 tuple(state["weights"]) if state is not None
-                else tuple(w.copy() for w in weights_np)
+                else tuple(np.asarray(w) for w in weights)
             )
         fname_it = iter(zip(files, readable))
 
@@ -218,15 +238,10 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             if emit_header_only_until_readable(silent=True) is None:
                 break
         while done < X.shape[0]:
-            Xc = jnp.asarray(X[done : done + chunk])
-            Tc = jnp.asarray(T[done : done + chunk])
+            Xc = X[done : done + chunk]
+            Tc = T[done : done + chunk]
             try:
-                weights, stats = loop.train_epoch_lax(
-                    weights, dw0, Xc, Tc,
-                    alpha, delta,
-                    model=model, momentum=momentum,
-                    min_iter=min_iter, max_iter=max_iter,
-                )
+                weights, stats = train_epoch(weights, dw0, Xc, Tc)
                 stats = tuple(np.asarray(s) for s in stats)
             except jax.errors.JaxRuntimeError:
                 # worker killed mid-dispatch (likely the execution
@@ -427,7 +442,24 @@ def _make_tp_state(
             alpha_j, delta_j,
         )
 
-    return weights, dw0, train_one
+    ep_fn = tp.make_train_epoch_fn(
+        mesh, len(padded),
+        model=model, momentum=momentum,
+        min_iter=min_iter, max_iter=max_iter, n_out=n_out,
+    )
+
+    def train_epoch(w, m0, Xc, Tc):
+        # targets zero-padded to the padded output rows (a fixed point
+        # of the sharded math, parallel/mesh.py)
+        t_pad = np.zeros((Tc.shape[0], pad_out), dtype=dtype)
+        t_pad[:, : Tc.shape[1]] = Tc
+        return ep_fn(
+            w, m0,
+            jnp.asarray(Xc, dtype=dtype), jnp.asarray(t_pad),
+            alpha_j, delta_j,
+        )
+
+    return weights, dw0, train_one, train_epoch
 
 
 def _print_train_tokens(res, model: str, momentum: bool) -> None:
@@ -486,8 +518,9 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
 
     debug.device_alloc_report(tuple(w_sh))
 
-    if conf.seed == 0:
-        conf.seed = int(time.time())
+    from hpnn_tpu.parallel import dist
+
+    conf.seed = dist.resolve_time_seed(conf.seed)
 
     # Bulk-read once, then one chunked vmapped forward (plain or TP)
     # for every file matching the kernel dims — the faithful 10k-file

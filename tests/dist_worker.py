@@ -34,6 +34,15 @@ def main() -> int:
     n_data = mesh.shape[mesh_mod.DATA_AXIS]
     assert n_data == jax.device_count() == 4
 
+    # seed-0 materialization must agree across ranks (rank-0 clock
+    # broadcast): every rank would otherwise generate a different
+    # kernel at conf load
+    from jax.experimental import multihost_utils
+
+    s = dist.resolve_time_seed(0)
+    all_s = np.asarray(multihost_utils.process_allgather(np.int64(s)))
+    assert (all_s == all_s[0]).all(), all_s
+
     import jax.numpy as jnp
 
     k, _ = kernel_mod.generate(7, 6, [5], 3)

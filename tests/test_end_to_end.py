@@ -154,6 +154,85 @@ def test_tp_cli_rejects_data_axis(workdir, capsys):
     assert train_nn.main(["--mesh", "2x2", conf]) == -1
 
 
+def test_tp_fused_round_chunked_matches_unchunked(workdir, capsys,
+                                                 monkeypatch):
+    """TP fused rounds (scan inside the shard_map) with a small
+    HPNN_FUSE_CHUNK: chunk-carried sharded weights + chunked token
+    emission == the default one-chunk TP round."""
+    conf = _conf(workdir)
+    assert train_nn.main(["-v", "-v", "--mesh", "1x4", conf]) == 0
+    want = capsys.readouterr().out
+    want_kernel = open("kernel.opt").read()
+
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "3")
+    assert train_nn.main(["-v", "-v", "--mesh", "1x4", conf]) == 0
+    chunked = capsys.readouterr().out
+    assert chunked == want
+    assert open("kernel.opt").read() == want_kernel
+
+
+def test_tp_fused_crash_resume(workdir, capsys, monkeypatch):
+    """A TP fused round killed mid-chunk resumes from the checkpoint
+    (padded host weights re-sharded onto the mesh): concatenated token
+    stream and final weights identical to an uninterrupted TP round."""
+    import jax
+
+    from hpnn_tpu import config
+    from hpnn_tpu.cli import common
+    from hpnn_tpu.parallel import tp
+    from hpnn_tpu.train import driver
+
+    conf_path = _conf(workdir)
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "8")
+    assert train_nn.main(["-v", "-v", "--mesh", "1x4", conf_path]) == 0
+    want = capsys.readouterr().out
+    want_kernel = open("kernel.opt").read()
+
+    mesh = common.tp_mesh("1x4")
+    state = workdir / "tp.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    real_make = tp.make_train_epoch_fn
+    calls = {"n": 0}
+
+    def make_dying(*a, **kw):
+        real = real_make(*a, **kw)
+
+        def fn(*fa, **fkw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise jax.errors.JaxRuntimeError(
+                    "UNAVAILABLE: TPU worker process crashed (simulated)")
+            return real(*fa, **fkw)
+
+        return fn
+
+    monkeypatch.setattr(tp, "make_train_epoch_fn", make_dying)
+    conf = config.load_conf(conf_path)
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        driver.train_kernel(conf, mesh=mesh)
+    part1 = capsys.readouterr().out
+    # handler checkpoint: zero progress, chunk kept (already below the
+    # 32-sample halving floor), PADDED weights
+    assert state.exists()
+    z = np.load(state, allow_pickle=False)
+    assert int(z["done"]) == 0
+    assert int(z["chunk"]) == 8
+    assert z["w0"].shape[0] % 4 == 0  # padded to the model-axis size
+
+    conf2 = config.load_conf(conf_path)
+    assert driver.train_kernel(conf2, mesh=mesh) is True
+    part2 = capsys.readouterr().out
+
+    def training_lines(s):
+        return [ln for ln in s.splitlines() if "TRAINING FILE" in ln]
+
+    assert training_lines(part1 + part2) == training_lines(want)
+    assert not state.exists()
+    with open("kernel.opt", "w") as fp:
+        config.dump_kernel(conf2, fp)
+    assert open("kernel.opt").read() == want_kernel
+
+
 def test_fused_round_token_alignment_with_bad_files(workdir, capsys,
                                                     monkeypatch):
     """Fused-round edge cases: an unreadable or dimension-mismatched
