@@ -24,6 +24,14 @@ dispatch number; that series continues unchanged under
 `per_sample.per_sample_dispatch` (r02: 7.756), so cross-round
 comparisons should use it, not `value`, across the r02→r03 boundary.
 
+Since r04, A/B comparisons are PAIRED: fused-vs-streaming repeats are
+interleaved (per-round ratio), and the batch section adds slope
+timing — us/step from (t(13120 steps) − t(320 steps))/Δsteps, which
+cancels the ~65–110 ms per-dispatch tunnel round-trip — with
+Pallas/XLA/B=2048 variants interleaved round-robin and a paired
+per-round delta.  The absolute 8000-step scan numbers continue the
+r01–r03 series (they include ~8 us/step of amortized tunnel cost).
+
 Baseline: a locally-built reference (gcc -O2 -fopenmp -D_OMP, best
 this toolchain allows — no cblas, no MPI) with the tutorial's -O4 -B4
 flags on the same 64-sample workload.  When gcc + /root/reference are
@@ -54,9 +62,16 @@ N_SAMPLES = 64
 REPEATS = 3
 BATCH_B = 1024
 BATCH_STEPS = 200       # per-step-dispatch mode (each step a host dispatch)
-SCAN_STEPS = 8000       # scan mode (one dispatch for the whole chain;
-                        # large so the ~65 ms tunnel round-trip is noise)
+SCAN_STEPS = 8000       # absolute scan mode (one dispatch for the chain;
+                        # kept for r01-r03 series continuity — includes
+                        # ~8 us/step of amortized tunnel round-trip)
 SCAN_REPEATS = 5
+# slope mode: us/step = (t(E_BIG) - t(E_SMALL)) / d_steps cancels the
+# constant per-dispatch tunnel round-trip (~65-110 ms on this link)
+SLOPE_S = 64            # steps per scanned epoch
+SLOPE_E_SMALL = 5
+SLOPE_E_BIG = 205
+SLOPE_REPEATS = 5
 # v5e single-chip peak: 394 TFLOP/s bf16 (default matmul precision
 # feeds the MXU bf16 inputs with f32 accumulation)
 V5E_PEAK_FLOPS = 394e12
@@ -112,17 +127,6 @@ def bench_per_sample():
     kw = dict(model="ann", momentum=False,
               min_iter=loop.MIN_BP_ITER, max_iter=loop.MAX_BP_ITER)
 
-    w, stats = loop.train_epoch_lax(  # warmup/compile
-        weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
-    np.asarray(stats[1][-1:])
-    fused_sps, iters = [], 0
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        w, stats = loop.train_epoch_lax(
-            weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
-        iters = int(np.asarray(stats[1]).sum())  # transfer fence
-        fused_sps.append(N_SAMPLES / (time.perf_counter() - t0))
-
     def one(weights, x, t):
         return loop.train_sample(
             weights, (),
@@ -130,10 +134,24 @@ def bench_per_sample():
             0.2, loop.DELTA_BP, **kw,
         )
 
-    r = one(weights0, *samples[0])  # warmup
+    # warm both paths
+    w, stats = loop.train_epoch_lax(
+        weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
+    np.asarray(stats[1][-1:])
+    r = one(weights0, *samples[0])
     int(r.n_iter)
-    sps_runs = []
+
+    # INTERLEAVED repeats: each round measures fused then streaming
+    # under the same link conditions, so the fused-vs-streaming ratio
+    # is a paired statistic (VERDICT r3 item 4)
+    fused_sps, sps_runs, iters, total_iters = [], [], 0, 0
     for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        w, stats = loop.train_epoch_lax(
+            weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
+        iters = int(np.asarray(stats[1]).sum())  # transfer fence
+        fused_sps.append(N_SAMPLES / (time.perf_counter() - t0))
+
         weights = weights0
         total_iters = 0
         t0 = time.perf_counter()
@@ -141,14 +159,18 @@ def bench_per_sample():
             r = one(weights, x, t)
             weights = r.weights
             total_iters += int(r.n_iter)  # host sync, like the token prints
-        dt = time.perf_counter() - t0
-        sps_runs.append(N_SAMPLES / dt)
+        sps_runs.append(N_SAMPLES / (time.perf_counter() - t0))
+    paired_ratio = [round(f / s, 2) for f, s in zip(fused_sps, sps_runs)]
     return {
         "samples_per_s": _stats(fused_sps),
         "total_inner_iters": iters,
         "per_sample_dispatch": {
             "samples_per_s": _stats(sps_runs),
             "total_inner_iters": total_iters,
+        },
+        "paired_fused_vs_streaming_ratio": {
+            "per_round": paired_ratio,
+            "median": round(statistics.median(paired_ratio), 2),
         },
     }
 
@@ -223,18 +245,6 @@ def bench_batch():
         SCAN_STEPS, SCAN_REPEATS,
     )
 
-    # -- fused Pallas step under the same scan (what train_nn --batch
-    # dispatches on a single TPU chip; ops/pallas_train.py)
-    pal_sps, pal_stps = [], []
-    if jax.default_backend() == "tpu":
-        from hpnn_tpu.ops import pallas_train
-
-        pal_fn = pallas_train.make_pallas_epoch_fn(weights, momentum=False)
-        pal_sps, pal_stps, _ = _timed_runs(
-            lambda: pal_fn(w_sh, (), X_dev, T_dev, idx)[2][-1:],
-            SCAN_STEPS, SCAN_REPEATS,
-        )
-
     # -- per-step dispatch mode (the old measurement)
     step = dp.make_gspmd_train_step(mesh, weights, model="ann", momentum=False)
     Xs, Ts = dp.shard_batch(X, T, mesh)
@@ -249,35 +259,120 @@ def bench_batch():
         _dispatch_chain, BATCH_STEPS, REPEATS,
     )
 
+    # -- slope-timed paired section: Pallas vs XLA, plus B=2048 -------
+    # Each sample times one small and one big multi-epoch dispatch and
+    # takes dt/d_steps; variants are interleaved round-robin so every
+    # repeat is a PAIRED comparison under the same link conditions
+    # (the r03 best-of-N comparison was retracted for exactly this).
+    from jax import lax
+
+    def make_multi(step_math, B):
+        @jax.jit
+        def fn(weights, X, T, idx_all):
+            def epoch(w, ix_e):
+                def body(c, ix):
+                    w2, _m, l = step_math(c, X[ix], T[ix])
+                    return w2, l
+                return lax.scan(body, w, ix_e)
+            return lax.scan(epoch, weights, idx_all)
+        return fn
+
+    def xla_step(w, Xb, Tb):
+        return dp.train_step_math(w, (), Xb, Tb, model="ann",
+                                  momentum=False, lr=0.001, alpha=0.2)
+
+    def pal_step(w, Xb, Tb):
+        from hpnn_tpu.ops import pallas_train
+
+        return pallas_train.train_step_fused_batch(
+            w, (), Xb, Tb, model="ann", momentum=False, lr=0.001, alpha=0.2)
+
+    def slope_setup(B, step_math):
+        rngb = np.random.RandomState(11)
+        Xb = jnp.asarray(rngb.uniform(0, 255, (B, 784)).astype(np.float32))
+        Tb_np = np.full((B, 10), -1.0, dtype=np.float32)
+        Tb_np[np.arange(B), rngb.randint(0, 10, B)] = 1.0
+        Tb = jnp.asarray(Tb_np)
+
+        def mk_idx(E):
+            return jnp.asarray(
+                np.stack([np.stack([
+                    np.random.RandomState(e * 101 + s).permutation(B)
+                    for s in range(SLOPE_S)]) for e in range(E)]),
+                dtype=jnp.int32)
+
+        fn = make_multi(step_math, B)
+        i_s, i_b = mk_idx(SLOPE_E_SMALL), mk_idx(SLOPE_E_BIG)
+
+        def once(ix):
+            t0 = time.perf_counter()
+            r = fn(weights, Xb, Tb, ix)
+            np.asarray(r[1]).ravel()
+            return time.perf_counter() - t0
+
+        once(i_s)
+        once(i_b)  # warm both shapes
+        d = (SLOPE_E_BIG - SLOPE_E_SMALL) * SLOPE_S
+
+        def sample():
+            return 1e6 * (once(i_b) - once(i_s)) / d
+
+        return sample
+
+    variants = {"xla_B1024": slope_setup(BATCH_B, xla_step)}
+    if jax.default_backend() == "tpu":
+        variants["pallas_B1024"] = slope_setup(BATCH_B, pal_step)
+    variants["xla_B2048"] = slope_setup(2 * BATCH_B, xla_step)
+    slope_us = {k: [] for k in variants}
+    for _ in range(SLOPE_REPEATS):
+        for k, sample in variants.items():  # interleaved: paired rounds
+            slope_us[k].append(sample())
+    slope = {
+        k: {"us_per_step": [round(v, 2) for v in vals],
+            "median_us": round(statistics.median(vals), 2),
+            "samples_per_s_M": round(
+                (2 * BATCH_B if k.endswith("2048") else BATCH_B)
+                / statistics.median(vals), 2)}
+        for k, vals in slope_us.items()
+    }
+    if "pallas_B1024" in slope_us:
+        deltas = [
+            round(100.0 * (b - a) / b, 2)
+            for a, b in zip(slope_us["pallas_B1024"], slope_us["xla_B1024"])
+        ]  # + = pallas faster per paired round
+        slope["paired_pallas_vs_xla_pct"] = {
+            "per_round": deltas,
+            "median": round(statistics.median(deltas), 2),
+        }
+
     # FLOPs/step: fwd 2PB + bwd 4PB + loss re-forward 2PB = 8PB.
-    # Headline = the fastest production dispatch (Pallas on TPU, the
-    # XLA scan elsewhere) — exactly train_nn --batch's choice.
+    # Achieved rate from the SLOPE of the production dispatch (the XLA
+    # scan) — the absolute-mode number keeps ~8 us/step of tunnel
+    # amortization and is reported separately for series continuity.
     flops_per_step = 8 * n_params * BATCH_B
-    head_sps, head_stps = (pal_sps, pal_stps) if pal_stps else (
-        scan_sps, scan_stps)
-    med_stps = statistics.median(head_stps)
-    achieved = flops_per_step * med_stps
+    slope_med_us = slope["xla_B1024"]["median_us"]
+    achieved = flops_per_step / (slope_med_us * 1e-6)
+    # bandwidth-bound ceiling (BASELINE.md roofline): ~11.6 MB/step at
+    # ~819 GB/s -> the %-peak figure is reported against BOTH bounds
+    hbm_bytes_per_step = (3 * 4 * BATCH_B * 784 + 2 * 4 * n_params
+                          + 3 * 4 * BATCH_B * 10)
+    bw_ceiling_flops = flops_per_step / (hbm_bytes_per_step / 819e9)
     out = {
         "batch_size": BATCH_B,
-        "samples_per_s": _stats(head_sps),
-        "steps_per_s": _stats(head_stps),
+        "dispatch": "xla_scan",  # production default since r04
+        "samples_per_s": _stats(scan_sps),
+        "steps_per_s": _stats(scan_stps),
+        "slope": slope,
         "achieved_tflops": round(achieved / 1e12, 3),
         "pct_v5e_bf16_peak": round(100.0 * achieved / V5E_PEAK_FLOPS, 3),
+        "pct_hbm_bound_ceiling": round(
+            100.0 * achieved / bw_ceiling_flops, 1),
         "final_loss": final_loss,
-        "xla_scan": {
-            "samples_per_s": _stats(scan_sps),
-            "steps_per_s": _stats(scan_stps),
-        },
         "per_step_dispatch": {
             "samples_per_s": _stats(disp_sps),
             "steps_per_s": _stats(disp_stps),
         },
     }
-    if pal_stps:
-        out["pallas_fused"] = {
-            "samples_per_s": _stats(pal_sps),
-            "steps_per_s": _stats(pal_stps),
-        }
     return out
 
 

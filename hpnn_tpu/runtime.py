@@ -198,22 +198,32 @@ def _honor_platform_env() -> None:
     semantics jax documents.  No-op when the env var is unset."""
     plat = os.environ.get("JAX_PLATFORMS")
     if not plat:
-        return
+        return None
     try:
         import jax
 
-        # config.update is a no-op for backend selection once backends
-        # exist — detect that and say so instead of silently honoring
-        # the override this function is meant to undo.
-        already = False
-        try:
-            from jax._src import xla_bridge
-
-            already = xla_bridge.backends_are_initialized()
-        except Exception:
-            pass
+        # NOTE: only the config update happens here — nothing that
+        # initializes backends, because init_all calls this BEFORE
+        # init_dist and jax.distributed.initialize must precede any
+        # backend creation.  Whether the update actually took effect
+        # is checked in _warn_platform_mismatch AFTER init_tpu's
+        # jax.devices() probe (public API only — no jax._src).
         jax.config.update("jax_platforms", plat)
-        if already and jax.default_backend() not in plat.lower().split(","):
+    except Exception as exc:
+        log.nn_warn(sys.stderr, "JAX_PLATFORMS=%s not applied: %s\n", plat, exc)
+        return None
+    return plat
+
+
+def _warn_platform_mismatch(plat: str) -> None:
+    """After backends exist: if the active backend is not one of the
+    platforms JAX_PLATFORMS requested, the env var was silently
+    ignored (backends were already initialized, e.g. by a site hook
+    at interpreter startup) — say so instead of degrading silently."""
+    try:
+        import jax
+
+        if jax.default_backend() not in plat.lower().split(","):
             log.nn_warn(
                 sys.stderr,
                 "JAX_PLATFORMS=%s ignored: backends already initialized "
@@ -237,10 +247,12 @@ def init_all(init_verbose: int = 0) -> int:
     init_runtime()
     if init_verbose:
         set_verbose(init_verbose)
-    _honor_platform_env()
+    plat = _honor_platform_env()
     init_dist()
     init_threads()
     init_tpu()
+    if plat:
+        _warn_platform_mismatch(plat)
     _initialized = True
     log.nn_out(
         sys.stdout,

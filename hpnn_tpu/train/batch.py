@@ -190,6 +190,21 @@ def accuracy_counts(out: np.ndarray, T: np.ndarray, model: str) -> int:
     return int(_count_correct(np, out, T, model))
 
 
+def _batch_state_key(sample_dir, model, momentum, shapes, B, lr, epochs,
+                     init_key=""):
+    """Round identity for batch-mode crash-resume checkpoints: the
+    fused-round scheme (driver._fuse_state_key — census + network +
+    starting-weights identity) extended with the batch hyperparameters
+    (a checkpoint from a different B/lr/epoch-count protocol is a
+    different run)."""
+    from hpnn_tpu.train.driver import _fuse_state_key
+
+    return _fuse_state_key(
+        sample_dir, model, momentum, shapes,
+        f"batch/B{B}/lr{lr}/E{epochs}/{init_key}",
+    )
+
+
 def train_kernel_batched(
     conf: NNConf,
     batch_size: int,
@@ -257,16 +272,16 @@ def train_kernel_batched(
     # data axis: host permutes and uploads per epoch.
     n_data = mesh.shape[mesh_mod.DATA_AXIS]
     gather = n_data == 1
-    # fused Pallas step where it measures faster: one TPU chip
-    # (BASELINE.md head-to-head: +9..19% steps/s over the XLA scan at
-    # the MNIST/XRD topologies, loss-identical; parity proven in
-    # tests/test_pallas.py).  HPNN_PALLAS=0 forces the XLA path;
-    # multi-device meshes always use GSPMD (the fused kernel is
-    # single-device).
-    # working set must fit the ~16 MB/core VMEM budget: batch X/T, the
-    # acts+deltas scratch (2·B·Σout_l), and the weights (aliased
-    # in-place, counted once) — otherwise Mosaic fails to compile where
-    # the HBM-resident XLA path is fine, so fall back
+    # the fused Pallas batch step is OPT-IN (HPNN_PALLAS=1): the r04
+    # paired slope measurement (BASELINE.md roofline section) shows it
+    # speed-identical to the XLA scan (21.5 vs 21.3 us/step at the
+    # MNIST topology, B=1024 — the step is HBM-bound, so on-chip fusion
+    # buys nothing the scan doesn't already have), while the XLA path
+    # has no VMEM ceiling and agrees exactly with the parity-pinned
+    # math step for SNN on hardware.  Parity of the kernel itself is
+    # still proven in tests/test_pallas.py.
+    # VMEM gate for the opt-in: batch X/T, acts+deltas scratch
+    # (2·B·Σout_l), weights (aliased in-place, counted once)
     n_outs = sum(int(w.shape[0]) for w in weights)
     n_in = int(weights[0].shape[1])
     n_w = sum(int(np.asarray(w).size) for w in weights)
@@ -281,7 +296,7 @@ def train_kernel_batched(
         and jax.default_backend() == "tpu"
         and dtype == jnp.float32  # fused kernel is f32-only
         and vmem_bytes <= 12 * 2**20
-        and os.environ.get("HPNN_PALLAS", "1") != "0"
+        and os.environ.get("HPNN_PALLAS", "0") == "1"
     )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -293,6 +308,13 @@ def train_kernel_batched(
         # per-epoch (losses, count) scalars come home
         if lr is None:
             lr = dp.default_lr(model, momentum)
+
+        def _math_step(w, m, Xb, Tb):
+            return dp.train_step_math(
+                w, m, Xb, Tb, model=model, momentum=momentum,
+                lr=lr, alpha=0.2,
+            )
+
         if use_pallas:
             from hpnn_tpu.ops import pallas_train
 
@@ -302,11 +324,7 @@ def train_kernel_batched(
                     lr=lr, alpha=0.2,
                 )
         else:
-            def step_fn(w, m, Xb, Tb):
-                return dp.train_step_math(
-                    w, m, Xb, Tb, model=model, momentum=momentum,
-                    lr=lr, alpha=0.2,
-                )
+            step_fn = _math_step
         multi_fn = make_multi_epoch_fn(step_fn, make_device_count_fn(model=model))
     else:
         epoch_fn = dp.make_gspmd_epoch_fn(
@@ -333,8 +351,63 @@ def train_kernel_batched(
         # eval bank, placed once (replicated) instead of re-uploaded
         # per epoch
         X_eval = dp.global_put(Xd, rep)
+    # crash-resume checkpoints (HPNN_FUSE_STATE, the fused-round
+    # pattern, driver.py): persist (completed epochs, weights[, dw])
+    # after every dispatch so a worker crash mid-protocol loses at most
+    # one dispatch block, not the whole run.  The RNG fast-forwards by
+    # replaying `done` epoch permutations from the stored seed.
+    # Single-process only: under multi-process the ranks would need a
+    # shared filesystem AND a resume barrier — out of scope, so the
+    # checkpoint quietly stays off there.
+    from hpnn_tpu.train.driver import (
+        _init_identity, _load_fuse_state, _save_fuse_state,
+    )
+
+    state_path = os.environ.get("HPNN_FUSE_STATE")
+    if state_path and jax.process_count() > 1:
+        state_path = None
+    state_key = None
+    state = None
+    if state_path:
+        state_key = _batch_state_key(
+            conf.samples, model, momentum,
+            tuple(tuple(int(d) for d in w.shape) for w in weights),
+            B, lr, epochs,
+            _init_identity(conf, [np.asarray(w) for w in weights]),
+        )
+        state = _load_fuse_state(state_path, state_key)
+        if state is not None and conf.seed not in (0, int(state["seed"])):
+            state = None  # different seeded run requested: start over
+    done_epochs = 0
+    cap_hint = 0  # gather-path epochs-per-dispatch cap carried in the
+    # checkpoint's chunk field; halved when a resume finds zero
+    # progress since the last resume (SIGKILLed over-budget dispatch —
+    # the batch twin of the fused-round stall halving)
+    if state is not None:
+        conf.seed = int(state["seed"])
+        done_epochs = int(state["done"])
+        cap_hint = int(state["chunk"])
+        if int(state["resume_done"]) == done_epochs and cap_hint:
+            cap_hint = max(1, cap_hint // 2)
+        saved = tuple(
+            np.asarray(w, dtype=dtype) for w in state["weights"]
+        )
+        n_l = len(weights)
+        w_sh = dp.place_kernel(saved[:n_l], mesh)
+        if momentum:
+            dw_sh = dp.place_kernel(saved[n_l:], mesh)
     _resolve_seed(conf)
     rng = np.random.RandomState(conf.seed & 0x7FFFFFFF)
+
+    def _save_state(epoch_now, cap=0, resume_done=-1):
+        if not state_path:
+            return
+        host = [dp.host_fetch(w, mesh) for w in w_sh]
+        host += [dp.host_fetch(m, mesh) for m in dw_sh] if momentum else []
+        _save_fuse_state(
+            state_path, state_key, conf.seed, epoch_now, cap, host,
+            resume_done=resume_done)
+
     loss = float("nan")
     pad = (-n) % B
     if pad:
@@ -367,12 +440,32 @@ def train_kernel_batched(
         return np.resize(order, n + pad) if pad else order
 
     n_steps = (n + pad) // B
+    for _ in range(done_epochs):
+        # resume: replay the consumed permutation draws (one per epoch)
+        # so the remaining epochs shuffle exactly as the crashed run
+        # would have; their tokens were already printed by it
+        epoch_order()
     if gather:
-        # cap the steps per dispatch (the tunneled worker kills very
-        # long dispatches); batch steps are fixed-cost, so the cap
-        # maps to a bounded run time
+        # cap the epochs per dispatch (the tunneled worker kills very
+        # long dispatches, ~100 s observed).  The first blocks use a
+        # step-count heuristic; once a clean (compile-free) block has
+        # been timed, the cap re-derives from the measured rate so
+        # slower topologies stay under the budget too.  The cap is
+        # then FROZEN — every distinct block shape is a recompile.
+        import time as _time
+
         e_cap = max(1, 65536 // max(1, n_steps))
-        epoch = 0
+        if cap_hint:
+            e_cap = min(e_cap, cap_hint)
+        # mark this position as resumed (and cover a SIGKILL during
+        # the very first dispatch): a next resume that finds `done`
+        # unchanged halves the cap instead of retrying the same
+        # over-budget block forever
+        _save_state(done_epochs, cap=e_cap, resume_done=done_epochs)
+        budget_s = float(os.environ.get("HPNN_DISPATCH_BUDGET_S", "60"))
+        epoch = done_epochs
+        block_i = 0
+        timed_cap = None
         while epoch < epochs:
             e_block = min(e_cap, epochs - epoch)
             idx = dp.global_put(
@@ -381,16 +474,52 @@ def train_kernel_batched(
                 ]).astype(np.int32),
                 rep,
             )
-            w_sh, dw_sh, losses, counts = multi_fn(
-                w_sh, dw_sh, X_dev, T_dev, idx)
-            losses = dp.host_fetch(losses, mesh)
-            counts = dp.host_fetch(counts, mesh)
+            t0 = _time.monotonic()
+            try:
+                w_sh, dw_sh, losses, counts = multi_fn(
+                    w_sh, dw_sh, X_dev, T_dev, idx)
+                losses = dp.host_fetch(losses, mesh)
+                counts = dp.host_fetch(counts, mesh)
+            except Exception as exc:
+                if (
+                    block_i == 0
+                    and use_pallas
+                    and "UNAVAILABLE" not in str(exc)
+                ):
+                    # Mosaic failed to compile the fused kernel for
+                    # this shape/topology (the VMEM heuristic is not a
+                    # compiler): rebuild on the XLA step and retry the
+                    # same block.  UNAVAILABLE = worker crash, not a
+                    # compile problem — let it propagate.
+                    log.nn_warn(
+                        sys.stderr,
+                        "fused batch kernel failed (%s); "
+                        "falling back to the XLA step\n",
+                        type(exc).__name__,
+                    )
+                    multi_fn = make_multi_epoch_fn(
+                        _math_step, make_device_count_fn(model=model))
+                    use_pallas = False
+                    # rewind the RNG so the retried block reuses the
+                    # SAME permutations the failed dispatch consumed
+                    rng = np.random.RandomState(conf.seed & 0x7FFFFFFF)
+                    for _ in range(epoch):
+                        epoch_order()
+                    continue
+                raise
+            dt = _time.monotonic() - t0
+            if block_i == 1 and timed_cap is None:
+                # first compile-free block: freeze the time-based cap
+                timed_cap = max(1, int(budget_s * e_block / max(dt, 1e-3)))
+                e_cap = min(e_cap, timed_cap)
+            block_i += 1
             for e in range(e_block):
                 epoch += 1
                 loss = float(losses[e].mean())
                 print_epoch(epoch, loss, int(counts[e]))
+            _save_state(epoch, cap=e_cap)
     else:
-        for epoch in range(1, epochs + 1):
+        for epoch in range(done_epochs + 1, epochs + 1):
             order = epoch_order()
             Xe = Xd[order].reshape(n_steps, B, -1)
             Te = Td[order].reshape(n_steps, B, -1)
@@ -400,10 +529,15 @@ def train_kernel_batched(
             out = np.asarray(eval_fn(w_sh, X_eval))
             okc = accuracy_counts(out, T, model)
             print_epoch(epoch, loss, okc)
+            _save_state(epoch)
     jax.block_until_ready(w_sh)
     conf.kernel = kernel_mod.Kernel(
         tuple(dp.host_fetch(w, mesh).astype(np.float64) for w in w_sh)
     )
+    # run completed: drop this run's checkpoint (unrelated keys are
+    # left alone, same discipline as the fused-round driver)
+    if state_path and _load_fuse_state(state_path, state_key) is not None:
+        os.remove(state_path)
     return True
 
 

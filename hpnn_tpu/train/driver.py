@@ -139,6 +139,12 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     # round replay the SAME shuffle it started with; an explicitly
     # seeded conf never adopts a checkpoint from a different seed.
     state_path = os.environ.get("HPNN_FUSE_STATE")
+    if state_path and jax.process_count() > 1:
+        # multi-process: the host_w snapshot would span non-addressable
+        # shards and every rank would race on the same checkpoint file;
+        # crash-resume is a single-process feature (same guard as
+        # batch.py)
+        state_path = None
     state_key = None
     state = None
     if state_path:
@@ -147,6 +153,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         state_key = _fuse_state_key(
             conf.samples, model, momentum,
             tuple(tuple(int(d) for d in w.shape) for w in weights),
+            _init_identity(conf, weights_np),
         )
         state = _load_fuse_state(state_path, state_key)
         if state is not None and conf.seed not in (0, int(state["seed"])):
@@ -220,6 +227,20 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             host_w = (
                 tuple(state["weights"]) if state is not None
                 else tuple(np.asarray(w) for w in weights)
+            )
+            if state is not None and int(state["resume_done"]) == done:
+                # a previous attempt already resumed at this exact
+                # point and died without progress — e.g. SIGKILLed by
+                # the tutorial timeout, which bypasses the
+                # JaxRuntimeError handler and its chunk-halving hint:
+                # halve here so a deterministically-over-budget chunk
+                # shrinks instead of retrying at the same size forever
+                chunk = max(min(32, chunk), chunk // 2)
+            # mark this position as resumed (and cover the
+            # killed-before-first-save case with an initial checkpoint)
+            _save_fuse_state(
+                state_path, state_key, conf.seed, done, chunk, host_w,
+                resume_done=done,
             )
         fname_it = iter(zip(files, readable))
 
@@ -310,15 +331,38 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     return True
 
 
-def _fuse_state_key(sample_dir, model, momentum, shapes):
+def _init_identity(conf, weights_np) -> str:
+    """Identity of the round's STARTING weights for checkpoint keys.
+
+    File-initialized rounds (``[init] kernel.opt`` — every tutorial
+    cont round) hash the loaded weight bytes, so a leftover checkpoint
+    from a different round over the same dir/topology (e.g. round 0's,
+    with ``[seed] 0``) is never silently adopted with the wrong weights
+    (advisor r3).  Generated rounds keep the literal "generate": their
+    checkpoint stores the whole round state (including the weights the
+    crashed process generated), so adopting it IS the correct resume —
+    a regenerated-weights hash would only force a restart."""
+    if not getattr(conf, "f_kernel", None):
+        return "generate"
+    import hashlib
+
+    h = hashlib.sha256()
+    for w in weights_np:
+        h.update(np.ascontiguousarray(np.asarray(w)).tobytes())
+    return h.hexdigest()
+
+
+def _fuse_state_key(sample_dir, model, momentum, shapes, init_key=""):
     """Round identity for crash-resume checkpoints: the sample dir's
-    file census plus the network identity (model/mode/topology), so a
+    file census plus the network identity (model/mode/topology) plus
+    the starting-weights identity (:func:`_init_identity`), so a
     checkpoint is never adopted by a different round over the same
-    samples (e.g. the MNIST ANN and SNN tutorials share a dir)."""
+    samples (e.g. the MNIST ANN and SNN tutorials share a dir, and
+    consecutive tutorial rounds share dir AND topology)."""
     import hashlib
 
     names = sample_io.list_sample_files(sample_dir)
-    ident = f"{model}/{momentum}/{shapes}"
+    ident = f"{model}/{momentum}/{shapes}/{init_key}"
     return hashlib.sha256(
         ("\n".join(names) + "\0" + ident).encode()
     ).hexdigest()
@@ -338,21 +382,26 @@ def _load_fuse_state(path, key):
             "seed": int(z["seed"]),
             "done": int(z["done"]),
             "chunk": int(z["chunk"]),
+            "resume_done": int(z["resume_done"]) if "resume_done" in z else -1,
             "weights": tuple(z[f"w{i}"] for i in range(n)),
         }
     except Exception:
         return None  # unreadable/partial checkpoint: start over
 
 
-def _save_fuse_state(path, key, seed, done, chunk, weights):
+def _save_fuse_state(path, key, seed, done, chunk, weights, resume_done=-1):
     """Atomically checkpoint a fused round: ``done`` samples trained
     (absolute — independent of any chunk-size change), ``chunk`` the
-    suggested dispatch size for the next attempt."""
+    suggested dispatch size for the next attempt.  ``resume_done``
+    marks a just-resumed position (written at load time) so the NEXT
+    resume can tell "no progress since the last resume" — the
+    SIGKILL-without-crash-handler case (advisor r3)."""
     tmp = path + ".tmp"
     arrs = {f"w{i}": np.asarray(w) for i, w in enumerate(weights)}
     np.savez(
         tmp, key=key, seed=seed,
-        done=done, chunk=chunk, n_layers=len(weights), **arrs,
+        done=done, chunk=chunk, resume_done=resume_done,
+        n_layers=len(weights), **arrs,
     )
     # np.savez appends .npz to names without it
     src = tmp if os.path.exists(tmp) else tmp + ".npz"
@@ -522,62 +571,87 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
 
     conf.seed = dist.resolve_time_seed(conf.seed)
 
-    # Bulk-read once, then one chunked vmapped forward (plain or TP)
-    # for every file matching the kernel dims — the faithful 10k-file
-    # eval must not pay 10k dispatches (ref protocol:
-    # src/libhpnn.c:1306-1536).  Outputs are order-independent, so
-    # precomputing preserves the seeded-shuffle token stream: in parity
-    # mode (f64 CPU) byte-for-byte; on TPU f32 the batched matmul may
-    # differ from the per-sample matvec at f32 rounding (~1e-7 rel,
-    # HIGHEST precision pinned — see batch.make_eval_fn), visible only
-    # in -vvv probability digits.  Files that are unreadable/malformed
-    # or don't match the kernel dims keep the per-sample path's exact
+    # Stream-read + chunked vmapped forward (plain or TP) for every
+    # file matching the kernel dims — the faithful 10k-file eval must
+    # not pay 10k dispatches (ref protocol: src/libhpnn.c:1306-1536).
+    # Outputs are order-independent, so precomputing preserves the
+    # seeded-shuffle token stream: in parity mode (f64 CPU)
+    # byte-for-byte; on TPU f32 the batched matmul may differ from the
+    # per-sample matvec at f32 rounding (~1e-7 rel, HIGHEST precision
+    # pinned — see batch.make_eval_fn), visible only in -vvv
+    # probability digits.  Files that are unreadable/malformed or
+    # don't match the kernel dims keep the per-sample path's exact
     # behavior.  HPNN_NO_BATCH_EVAL=1 forces the per-sample path.
+    # Memory discipline: only each file's TARGET and its precomputed
+    # output row persist; inputs live one 4096-row chunk at a time
+    # (the previous bulk-read held the whole dir's inputs TWICE —
+    # ~760 MB at a 60k×784 f64 test dir).
     files = sample_io.list_sample_files(conf.tests)
-    rows = {
-        f: sample_io.read_sample(os.path.join(conf.tests, f)) for f in files
-    }
     n_in = weights_np[0].shape[1]
-    batchable = [
-        f
-        for f, s in rows.items()
-        if s is not None and s[0].size == n_in and s[1].size == n_out
-    ]
-    if os.environ.get("HPNN_NO_BATCH_EVAL"):
-        batchable = []
-    out_of = {}
-    if batchable:
-        chunk = 4096  # bound device memory on huge test sets
-        X = np.stack([rows[f][0] for f in batchable]).astype(dtype)
+    no_batch = bool(os.environ.get("HPNN_NO_BATCH_EVAL"))
+
+    batched_fwd = None
+
+    def _make_batched_fwd():
         if sharded is None:
             from hpnn_tpu.train.batch import make_eval_fn
 
             eval_fn = make_eval_fn(model=model)
-            batched_fwd = lambda xs: np.asarray(eval_fn(w_sh, jnp.asarray(xs)))
-        else:
-            from hpnn_tpu.parallel import tp as tp_mod
+            return lambda xs: np.asarray(eval_fn(w_sh, jnp.asarray(xs)))
+        from hpnn_tpu.parallel import tp as tp_mod
 
-            run_b = tp_mod.make_batched_run_fn(
-                mesh, len(padded), model=model, n_out=n_out
-            )
-            batched_fwd = lambda xs: np.asarray(
-                run_b(w_sh, tp_mod.replicate(jnp.asarray(xs), mesh))
-            )[:, :n_out]
-        outs = [batched_fwd(X[i : i + chunk]) for i in range(0, X.shape[0], chunk)]
-        allout = np.concatenate(outs, axis=0)
-        out_of = {f: allout[i] for i, f in enumerate(batchable)}
+        run_b = tp_mod.make_batched_run_fn(
+            mesh, len(padded), model=model, n_out=n_out
+        )
+        return lambda xs: np.asarray(
+            run_b(w_sh, tp_mod.replicate(jnp.asarray(xs), mesh))
+        )[:, :n_out]
+
+    chunk = 4096  # bound host+device memory on huge test sets
+    targets = {}   # fname -> target vector (batchable files)
+    out_of = {}    # fname -> precomputed output row
+    odd = {}       # readable but non-batchable: full sample, per-file fwd
+    bad = set()    # unreadable/malformed: header-only token line
+    grp_files, grp_x = [], []
+
+    def _flush():
+        nonlocal batched_fwd
+        if not grp_files:
+            return
+        if batched_fwd is None:
+            batched_fwd = _make_batched_fwd()
+        oc = batched_fwd(np.stack(grp_x).astype(dtype))
+        for j, f in enumerate(grp_files):
+            out_of[f] = oc[j]
+        grp_files.clear()
+        grp_x.clear()
+
+    for f in files:
+        s = sample_io.read_sample(os.path.join(conf.tests, f))
+        if s is None:
+            bad.add(f)
+        elif no_batch or s[0].size != n_in or s[1].size != n_out:
+            odd[f] = s
+        else:
+            targets[f] = s[1]
+            grp_files.append(f)
+            grp_x.append(s[0])
+            if len(grp_files) == chunk:
+                _flush()
+    _flush()
 
     from hpnn_tpu.utils.glibc_random import shuffled_order
 
     for idx in shuffled_order(conf.seed, len(files)):
         fname = files[idx]
         log.nn_out(sys.stdout, "TESTING FILE: %16.16s\t", fname)
-        sample = rows[fname]
-        if sample is None:
+        if fname in bad:
             continue
-        tr_in, tr_out = sample
-        pre = out_of.get(fname)
-        print_verdict(pre if pre is not None else forward(tr_in), tr_out, model)
+        if fname in out_of:
+            print_verdict(out_of[fname], targets[fname], model)
+        else:
+            tr_in, tr_out = odd[fname]
+            print_verdict(forward(tr_in), tr_out, model)
         log.flush()
 
 

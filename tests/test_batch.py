@@ -219,3 +219,135 @@ def test_multi_epoch_fn_matches_epoch_loop(tmp_path):
         assert int(cf(w, X, T)) == int(counts[e])
     for a, b in zip(w_all, w):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_batch_crash_resume(tmp_path, capsys, monkeypatch):
+    """HPNN_FUSE_STATE in batch mode: a run killed mid-protocol resumes
+    from the per-dispatch checkpoint — remaining epoch tokens continue
+    the numbering and final weights match an uninterrupted run."""
+    import jax
+
+    from hpnn_tpu.parallel import dp
+
+    epochs = 6
+    conf = _conf(tmp_path)
+    assert batch_mod.train_kernel_batched(conf, batch_size=8, epochs=epochs)
+    want = capsys.readouterr().out
+    want_w = [np.asarray(w).copy() for w in conf.kernel.weights]
+
+    state = tmp_path / "batch.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    # crash the 4th epoch dispatch (the suite's 8-device mesh takes the
+    # per-epoch non-gather path, so one epoch = one dispatch)
+    real_make = dp.make_gspmd_epoch_fn
+    calls = {"n": 0}
+
+    def make_dying(*a, **kw):
+        real = real_make(*a, **kw)
+
+        def fn(*fa, **fkw):
+            calls["n"] += 1
+            if calls["n"] == 4:
+                raise jax.errors.JaxRuntimeError(
+                    "UNAVAILABLE: TPU worker process crashed (simulated)")
+            return real(*fa, **fkw)
+
+        return fn
+
+    monkeypatch.setattr(dp, "make_gspmd_epoch_fn", make_dying)
+    (tmp_path / "run2").mkdir()
+    conf2 = _conf(tmp_path / "run2")
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        batch_mod.train_kernel_batched(conf2, batch_size=8, epochs=epochs)
+    part1 = capsys.readouterr().out
+    assert state.exists()
+    z = np.load(state, allow_pickle=False)
+    assert int(z["done"]) == 3  # three epochs survived the crash
+
+    monkeypatch.setattr(dp, "make_gspmd_epoch_fn", real_make)
+    (tmp_path / "run3").mkdir()
+    conf3 = _conf(tmp_path / "run3")
+    assert batch_mod.train_kernel_batched(conf3, batch_size=8, epochs=epochs)
+    part2 = capsys.readouterr().out
+
+    def epoch_lines(s):
+        return [ln for ln in s.splitlines() if "BATCH EPOCH" in ln]
+
+    # crashed run printed epochs 1-3, the resume 4-6; together = baseline
+    assert epoch_lines(part1) + epoch_lines(part2) == epoch_lines(want)
+    assert not state.exists()  # completed run cleans up
+    for a, b in zip(conf3.kernel.weights, want_w):
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-12)
+
+
+def test_batch_pallas_compile_fallback(tmp_path, capsys, monkeypatch):
+    """A fused-kernel compile failure on the first dispatch falls back
+    to the XLA step instead of aborting (advisor r3): forcing the
+    Pallas gate open on the CPU backend makes the first dispatch fail
+    exactly like an unsupported topology would on TPU."""
+    import jax
+
+    conf = _conf(tmp_path)
+    assert batch_mod.train_kernel_batched(
+        conf, batch_size=8, epochs=2, mesh_spec="1x1")
+    want = capsys.readouterr().out
+    want_w = [np.asarray(w).copy() for w in conf.kernel.weights]
+
+    monkeypatch.setenv("HPNN_PALLAS", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    (tmp_path / "run2").mkdir()
+    conf2 = _conf(tmp_path / "run2")
+    assert batch_mod.train_kernel_batched(
+        conf2, batch_size=8, epochs=2, mesh_spec="1x1")
+    got = capsys.readouterr().out
+    # same token stream and identical weights as the clean XLA run
+    assert [ln for ln in got.splitlines() if "BATCH EPOCH" in ln] == \
+        [ln for ln in want.splitlines() if "BATCH EPOCH" in ln]
+    for a, b in zip(conf2.kernel.weights, want_w):
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-12)
+
+
+def test_batch_stall_halves_dispatch_cap(tmp_path, capsys, monkeypatch):
+    """A batch dispatch killed WITHOUT any handler running (tutorial
+    timeout SIGKILL) must shrink the gather-path epochs-per-dispatch
+    cap on each zero-progress resume, like the fused-round chunk."""
+    conf = _conf(tmp_path)
+    state = tmp_path / "batch.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+
+    def killed_make(*a, **kw):
+        def fn(*fa, **fkw):
+            raise KeyboardInterrupt  # models SIGKILL: no handler runs
+
+        return fn
+
+    monkeypatch.setattr(batch_mod, "make_multi_epoch_fn", killed_make)
+    # n=24, B=8 -> n_steps=3 -> heuristic cap 65536//3 = 21845
+    expect = [21845, 10922, 5461]
+    for want_cap in expect:
+        with pytest.raises(KeyboardInterrupt):
+            batch_mod.train_kernel_batched(
+                _conf_copy(conf), batch_size=8, epochs=6, mesh_spec="1x1")
+        z = np.load(state, allow_pickle=False)
+        assert int(z["chunk"]) == want_cap
+        assert int(z["done"]) == 0
+    capsys.readouterr()
+
+    # a surviving attempt completes from the shrunken cap; tokens match
+    # an uninterrupted run
+    monkeypatch.undo()
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    c2 = _conf_copy(conf)
+    assert batch_mod.train_kernel_batched(
+        c2, batch_size=8, epochs=6, mesh_spec="1x1")
+    got = capsys.readouterr().out
+    monkeypatch.delenv("HPNN_FUSE_STATE")
+    c3 = _conf_copy(conf)
+    assert batch_mod.train_kernel_batched(
+        c3, batch_size=8, epochs=6, mesh_spec="1x1")
+    want = capsys.readouterr().out
+    assert [ln for ln in got.splitlines() if "BATCH EPOCH" in ln] == \
+        [ln for ln in want.splitlines() if "BATCH EPOCH" in ln]
+    for a, b in zip(c2.kernel.weights, c3.kernel.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+    assert not state.exists()

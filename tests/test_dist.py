@@ -10,6 +10,7 @@ import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hpnn_tpu.parallel import dist, dp, tp
 
@@ -35,6 +36,50 @@ def test_hybrid_mesh_runs_step():
     new_w, _, loss = step(w_sh, (), Xs, Ts)
     assert np.isfinite(float(loss))
     assert new_w[0].shape == weights[0].shape
+
+
+class _StubDev:
+    """Minimal device stand-in carrying ``slice_index`` — enough for
+    dist.hybrid_mesh's multi-slice branch (it only reads the attribute)
+    and for mesh_utils' physical-coords layout."""
+
+    def __init__(self, i, n_per_slice):
+        self.id = i
+        self.slice_index = i // n_per_slice
+        self.process_index = self.slice_index
+        self.platform = "tpu"
+        self.device_kind = "stub"
+        j = i % n_per_slice
+        self.coords = (j % 2, j // 2, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"Stub(id={self.id},slice={self.slice_index})"
+
+
+def test_hybrid_mesh_multi_slice():
+    """2 slices x 4 devices: the data axis must ride DCN (cross-slice)
+    and the model axis must stay inside a slice (ICI) — the bandwidth
+    hierarchy hybrid_mesh exists to respect."""
+    devs = [_StubDev(i, 4) for i in range(8)]
+    m = dist.hybrid_mesh(n_model=2, devices=devs)
+    assert m.shape == {"data": 4, "model": 2}
+    grid = np.asarray(m.devices)
+    slices = np.vectorize(lambda d: d.slice_index)(grid)
+    # model axis (columns): same slice everywhere
+    assert (slices[:, 0] == slices[:, 1]).all()
+    # data axis (rows): spans both slices
+    assert set(slices[:, 0]) == {0, 1}
+    # every stub appears exactly once
+    assert sorted(d.id for d in grid.ravel()) == list(range(8))
+
+
+def test_hybrid_mesh_multi_slice_non_divisible():
+    """A model axis that cannot fit inside a slice must be refused
+    (the model axis never spans slices)."""
+    devs = [_StubDev(i, 3) for i in range(6)]  # 2 slices x 3 devices
+    with pytest.raises(ValueError, match="divisible by the slice"):
+        dist.hybrid_mesh(n_model=2, devices=devs)
 
 
 def test_process_summary():

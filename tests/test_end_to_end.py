@@ -154,6 +154,55 @@ def test_tp_cli_rejects_data_axis(workdir, capsys):
     assert train_nn.main(["--mesh", "2x2", conf]) == -1
 
 
+def test_fused_round_stall_halves_chunk(workdir, capsys, monkeypatch):
+    """A dispatch killed WITHOUT the crash handler running (the
+    tutorial timeout's SIGKILL) must still shrink the chunk: each
+    resume that finds zero progress since the last resume halves the
+    stored hint (advisor r3)."""
+    from hpnn_tpu import config
+    from hpnn_tpu.train import driver, loop
+
+    conf_path = _conf(workdir)
+    state = workdir / "round.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "128")
+
+    def killed_epoch(*a, **kw):
+        # KeyboardInterrupt models SIGKILL for the checkpoint logic:
+        # it propagates past the JaxRuntimeError handler unhandled
+        raise KeyboardInterrupt
+
+    real_epoch = loop.train_epoch_lax
+    monkeypatch.setattr(loop, "train_epoch_lax", killed_epoch)
+    expect = [128, 64, 32]  # initial save, then two stall-halvings
+    for want_chunk in expect:
+        conf = config.load_conf(conf_path)
+        with pytest.raises(KeyboardInterrupt):
+            driver.train_kernel(conf)
+        z = np.load(state, allow_pickle=False)
+        assert int(z["chunk"]) == want_chunk
+        assert int(z["done"]) == 0
+    capsys.readouterr()
+
+    # a surviving attempt completes the round from the shrunken chunk
+    monkeypatch.setattr(loop, "train_epoch_lax", real_epoch)
+    monkeypatch.setenv("HPNN_FUSE_EPOCH", "0")
+    monkeypatch.delenv("HPNN_FUSE_STATE")
+    assert train_nn.main(["-v", "-v", "-v", conf_path]) == 0
+    want = capsys.readouterr().out
+    monkeypatch.setenv("HPNN_FUSE_EPOCH", "1")
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    conf2 = config.load_conf(conf_path)
+    assert driver.train_kernel(conf2) is True
+    got = capsys.readouterr().out
+
+    def training_lines(s):
+        return [ln for ln in s.splitlines() if "TRAINING FILE" in ln]
+
+    assert training_lines(got) == training_lines(want)
+    assert not state.exists()
+
+
 def test_tp_fused_round_chunked_matches_unchunked(workdir, capsys,
                                                  monkeypatch):
     """TP fused rounds (scan inside the shard_map) with a small

@@ -2,24 +2,19 @@
 # tutorials/mnist/opt_mnist.sh from their working directory).
 
 # train_round [args...]: one training round, appended to ./log.
-# Batch mode runs once, WITHOUT the timeout/retry machinery — its
-# rounds have no resume checkpoint, so killing one would restart it
-# from epoch 1 (and its dispatches are short anyway).  Per-sample rounds
-# checkpoint per chunk (HPNN_FUSE_STATE) and retry on failure — the
-# tunneled TPU worker can crash mid-round and a fresh process resumes
-# from the checkpoint.  Gives up (status 1) after TRAIN_RETRIES
-# attempts so callers can abort instead of recording bogus rounds.
+# Both modes checkpoint under HPNN_FUSE_STATE (per-sample rounds per
+# chunk, batch rounds per dispatch block) and retry on failure — the
+# tunneled TPU worker can crash or hang mid-round and a fresh process
+# resumes from the checkpoint.  A hung dispatch is SIGKILLed by the
+# per-attempt timeout, and the NEXT resume halves the dispatch size
+# when it finds zero progress (per-sample chunk / batch gather-path
+# epoch cap; a multi-chip batch round's unit is one epoch and cannot
+# shrink further).  Gives up (status 1) after TRAIN_RETRIES attempts
+# so callers can abort instead of recording bogus rounds.
 train_round() {
-    if [ -n "$BATCH_MODE" ]; then
-        train_nn -v -v -v "$@" &>> log
-        return
-    fi
     local tries=0
     while [ $tries -lt "${TRAIN_RETRIES:-15}" ]; do
         tries=$((tries+1))
-        # the tunneled worker sometimes HANGS a dispatch instead of
-        # raising — a per-attempt timeout turns that into a retry that
-        # resumes from the chunk checkpoint
         HPNN_FUSE_STATE="$PWD/round.state" \
             timeout -k 15 "${TRAIN_TIMEOUT:-900}" train_nn -v -v -v "$@" \
             &>> log && return 0
