@@ -219,17 +219,28 @@ def _warn_platform_mismatch(plat: str) -> None:
     """After backends exist: if the active backend is not one of the
     platforms JAX_PLATFORMS requested, the env var was silently
     ignored (backends were already initialized, e.g. by a site hook
-    at interpreter startup) — say so instead of degrading silently."""
+    at interpreter startup) — say so instead of degrading silently.
+
+    Only a cpu↔accelerator mismatch warns: an accelerator plugin may
+    answer under its canonical name (observed: ``JAX_PLATFORMS=axon``
+    honored but reported as backend ``tpu``), and warning there would
+    cry wolf on every tutorial run.  The case this guard exists for is
+    the documented ``JAX_PLATFORMS=cpu`` parity switch being defeated
+    (or an accelerator request landing on cpu)."""
     try:
         import jax
 
-        if jax.default_backend() not in plat.lower().split(","):
+        req = set(plat.lower().split(","))
+        active = jax.default_backend()
+        if active in req:
+            return
+        if ("cpu" in req) != (active == "cpu"):
             log.nn_warn(
                 sys.stderr,
                 "JAX_PLATFORMS=%s ignored: backends already initialized "
                 "on '%s'\n",
                 plat,
-                jax.default_backend(),
+                active,
             )
     except Exception as exc:
         log.nn_warn(sys.stderr, "JAX_PLATFORMS=%s not applied: %s\n", plat, exc)

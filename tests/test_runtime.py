@@ -35,7 +35,7 @@ def test_warn_platform_mismatch_silent_when_matching(capsys):
 
 def test_warn_platform_mismatch_warns_when_ignored(capsys):
     """Backends are already initialized on cpu in this suite; asking
-    for a different platform can no longer take effect and must WARN
+    for an accelerator can no longer take effect and must WARN
     (the silent-degradation case the old jax._src probe existed for)."""
     import jax
 
@@ -47,3 +47,19 @@ def test_warn_platform_mismatch_warns_when_ignored(capsys):
     err = capsys.readouterr().err
     assert "JAX_PLATFORMS=tpu" in err
     assert jax.default_backend() == "cpu"
+
+
+def test_warn_platform_mismatch_accelerator_alias_silent(capsys,
+                                                         monkeypatch):
+    """An accelerator plugin answering under its canonical name
+    (JAX_PLATFORMS=axon honored, backend reports 'tpu') must NOT warn
+    — only cpu↔accelerator mismatches are real defeats."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    log.set_verbose(2)
+    try:
+        runtime._warn_platform_mismatch("axon")
+    finally:
+        log.set_verbose(0)
+    assert "JAX_PLATFORMS" not in capsys.readouterr().err
