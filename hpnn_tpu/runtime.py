@@ -234,7 +234,12 @@ def _warn_platform_mismatch(plat: str) -> None:
         active = jax.default_backend()
         if active in req:
             return
-        if ("cpu" in req) != (active == "cpu"):
+        # warn iff a cpu-ONLY request landed on an accelerator, or an
+        # accelerator-only request landed on cpu.  A mixed priority
+        # list ("axon,cpu") landing on either side was honored.
+        if (req == {"cpu"} and active != "cpu") or (
+            active == "cpu" and "cpu" not in req
+        ):
             log.nn_warn(
                 sys.stderr,
                 "JAX_PLATFORMS=%s ignored: backends already initialized "

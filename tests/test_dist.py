@@ -294,3 +294,21 @@ def test_two_process_train_nn_cli(tmp_path):
     assert "TESTING FILE" in ev_single and "[PASS]" in ev_single
     assert _tokens(ev_outs[0]) == _tokens(ev_single)
     assert "TESTING FILE" not in ev_outs[1]
+
+
+def test_two_process_cli_model_sharded(tmp_path):
+    """`--mesh 1x2` under 2 processes: layer rows sharded ACROSS
+    processes — every weight fetch must cross-process all-gather
+    (dp.host_fetch) and the rank-0 kernel.opt must still be
+    byte-identical to a single-process run over the same mesh."""
+    single = _make_workdir(tmp_path, "single")
+    multi = _make_workdir(tmp_path, "multi")
+    args = ["-v", "-v", "--batch", "4", "--epochs", "3", "--lr", "0.1",
+            "--mesh", "1x2", "nn.conf"]
+    out_single = _run_cli("hpnn_tpu.cli.train_nn", args, single, _clean_env(2))
+    outs = _run_cli_cluster("hpnn_tpu.cli.train_nn", args, multi)
+    assert "NN: BATCH EPOCH" in out_single
+    assert _tokens(outs[0]) == _tokens(out_single)
+    assert "BATCH EPOCH" not in outs[1]  # rank-0-only tokens
+    for fname in ("kernel.opt", "kernel.tmp"):
+        assert (multi / fname).read_text() == (single / fname).read_text()

@@ -63,3 +63,17 @@ def test_warn_platform_mismatch_accelerator_alias_silent(capsys,
     finally:
         log.set_verbose(0)
     assert "JAX_PLATFORMS" not in capsys.readouterr().err
+
+
+def test_warn_platform_mismatch_fallback_list_silent(capsys, monkeypatch):
+    """A priority list with a cpu fallback ("axon,cpu") honored by the
+    accelerator (reported under its canonical name) must not warn."""
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    log.set_verbose(2)
+    try:
+        runtime._warn_platform_mismatch("axon,cpu")
+    finally:
+        log.set_verbose(0)
+    assert "JAX_PLATFORMS" not in capsys.readouterr().err
