@@ -316,12 +316,18 @@ def make_batched_run_fn(mesh, n_layers: int, *, model: str = "ann",
 
 
 def shard_kernel(weights, mesh):
-    """Place per-layer weights with rows on the model axis."""
+    """Place per-layer weights with rows on the model axis
+    (multi-process safe — each process materializes its shards from
+    the same host-global values, see dp.global_put)."""
+    from hpnn_tpu.parallel.dp import global_put
+
     return tuple(
-        jax.device_put(jnp.asarray(w), NamedSharding(mesh, s))
+        global_put(w, NamedSharding(mesh, s))
         for w, s in zip(weights, kernel_specs(len(weights)))
     )
 
 
 def replicate(x, mesh):
-    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(None)))
+    from hpnn_tpu.parallel.dp import global_put
+
+    return global_put(x, NamedSharding(mesh, P(None)))

@@ -230,12 +230,14 @@ def train_kernel_batched(
         return True  # CG/SPLX parse but are unimplemented (reference parity)
     # the census collective must run on EVERY rank before any
     # filesystem-dependent early return, or a rank whose dir is
-    # missing/empty would exit while its peers block in the gather
+    # missing/empty would exit while its peers block in the gather;
+    # a missing dir hashes as a marker so missing-vs-empty ranks
+    # disagree here (both erroring) rather than down-stream
     have_dir = os.path.isdir(conf.samples)
     names, X, T = sample_io.read_dir(conf.samples) if have_dir else ([], None, None)
     from hpnn_tpu.parallel import dist
 
-    if not dist.census_consistent(names):
+    if not dist.census_consistent(names if have_dir else ["\x00missing"]):
         log.nn_error(
             sys.stderr,
             "sample dir %s differs across processes (count or order)!\n",
@@ -558,7 +560,7 @@ def run_kernel_batched(conf: NNConf) -> None:
     names, X, T = sample_io.read_dir(conf.tests) if have_dir else ([], None, None)
     from hpnn_tpu.parallel import dist
 
-    if not dist.census_consistent(names):
+    if not dist.census_consistent(names if have_dir else ["\x00missing"]):
         log.nn_error(
             sys.stderr,
             "test dir %s differs across processes (count or order)!\n",

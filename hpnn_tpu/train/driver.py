@@ -48,11 +48,14 @@ def _compute_dtype():
     return np.dtype(np.float32)
 
 
-def _shuffled_files(directory: str, seed: int):
-    """Yield file names in the reference's seeded random draw order."""
+def _shuffled_files(flist, seed: int):
+    """Yield file names in the reference's seeded random draw order.
+
+    ``flist`` is the already-listed census — re-listing the dir here
+    could race against file creation and diverge from the list the
+    multi-process census verified."""
     from hpnn_tpu.utils.glibc_random import shuffled_order
 
-    flist = sample_io.list_sample_files(directory)
     for idx in shuffled_order(seed, len(flist)):
         yield flist[idx]
 
@@ -75,7 +78,27 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     if conf.train not in (NNTrain.BP, NNTrain.BPM):
         # CG/SPLX parse but are unimplemented (ref: src/libhpnn.c:1253-1257)
         return True
-    if not os.path.isdir(conf.samples):
+    # census collective on EVERY rank before any filesystem-dependent
+    # early return (multi-process TP: ranks must replay the same
+    # shuffle over the same files — see dist.census_consistent).  A
+    # missing dir hashes as a marker no real listing can produce, so
+    # missing-vs-empty ranks disagree HERE (both erroring) instead of
+    # diverging at the have_dir branch and deadlocking a collective.
+    from hpnn_tpu.parallel import dist
+
+    have_dir = os.path.isdir(conf.samples)
+    census = (
+        sample_io.list_sample_files(conf.samples) if have_dir
+        else ["\x00missing"]
+    )
+    if not dist.census_consistent(census):
+        log.nn_error(
+            sys.stderr,
+            "sample dir %s differs across processes (count or order)!\n",
+            conf.samples,
+        )
+        return False
+    if not have_dir:
         log.nn_error(sys.stderr, "can't open sample directory: %s\n", conf.samples)
         return False
 
@@ -154,6 +177,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             conf.samples, model, momentum,
             tuple(tuple(int(d) for d in w.shape) for w in weights),
             _init_identity(conf, weights_np),
+            names=census,
         )
         state = _load_fuse_state(state_path, state_key)
         if state is not None and conf.seed not in (0, int(state["seed"])):
@@ -161,10 +185,8 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     if state is not None:
         conf.seed = int(state["seed"])
     else:
-        from hpnn_tpu.parallel import dist
-
         conf.seed = dist.resolve_time_seed(conf.seed)
-    files = list(_shuffled_files(conf.samples, conf.seed))
+    files = list(_shuffled_files(census, conf.seed))
     # expected sample dims; a mismatched file is skipped with a warning
     # in both paths (the reference reads it into out-of-bounds C memory
     # — undefined behavior with nothing to be faithful to)
@@ -315,11 +337,13 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             weights, dw = res.weights, res.dw
             _print_train_tokens(res, model, momentum)
     if tp_state is not None:
-        from hpnn_tpu.parallel import mesh as mesh_mod
+        from hpnn_tpu.parallel import dp, mesh as mesh_mod
 
         orig_rows = [w.shape[0] for w in weights_np]
         conf.kernel = kernel_mod.Kernel(
-            mesh_mod.unpad_kernel([np.asarray(w) for w in weights], orig_rows)
+            mesh_mod.unpad_kernel(
+                [dp.host_fetch(w, mesh) for w in weights], orig_rows
+            )
         )
     else:
         conf.kernel = kernel_mod.Kernel(tuple(np.asarray(w) for w in weights))
@@ -352,16 +376,20 @@ def _init_identity(conf, weights_np) -> str:
     return h.hexdigest()
 
 
-def _fuse_state_key(sample_dir, model, momentum, shapes, init_key=""):
+def _fuse_state_key(sample_dir, model, momentum, shapes, init_key="",
+                    names=None):
     """Round identity for crash-resume checkpoints: the sample dir's
     file census plus the network identity (model/mode/topology) plus
     the starting-weights identity (:func:`_init_identity`), so a
     checkpoint is never adopted by a different round over the same
     samples (e.g. the MNIST ANN and SNN tutorials share a dir, and
-    consecutive tutorial rounds share dir AND topology)."""
+    consecutive tutorial rounds share dir AND topology).  Pass the
+    already-listed census as ``names`` to avoid a re-listing that can
+    race the listing actually trained over."""
     import hashlib
 
-    names = sample_io.list_sample_files(sample_dir)
+    if names is None:
+        names = sample_io.list_sample_files(sample_dir)
     ident = f"{model}/{momentum}/{shapes}/{init_key}"
     return hashlib.sha256(
         ("\n".join(names) + "\0" + ident).encode()
@@ -477,17 +505,24 @@ def _make_tp_state(
         model=model, momentum=momentum,
         min_iter=min_iter, max_iter=max_iter, n_out=n_out,
     )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from hpnn_tpu.parallel import dp
+
     pad_out = padded[-1].shape[0]
-    alpha_j = jnp.asarray(alpha, dtype=dtype)
-    delta_j = jnp.asarray(delta, dtype=dtype)
+    # scalars placed replicated over the mesh (multi-process safe:
+    # committed single-device arrays cannot enter a cross-process jit)
+    scal = NamedSharding(mesh, P())
+    alpha_j = dp.global_put(np.asarray(alpha, dtype=dtype), scal)
+    delta_j = dp.global_put(np.asarray(delta, dtype=dtype), scal)
 
     def train_one(w, m, x_np, t_np):
         t_pad = np.zeros(pad_out, dtype=dtype)
         t_pad[: t_np.shape[0]] = t_np
         return fn(
             w, m,
-            tp.replicate(jnp.asarray(x_np, dtype=dtype), mesh),
-            tp.replicate(jnp.asarray(t_pad), mesh),
+            tp.replicate(np.asarray(x_np, dtype=dtype), mesh),
+            tp.replicate(t_pad, mesh),
             alpha_j, delta_j,
         )
 
@@ -497,6 +532,8 @@ def _make_tp_state(
         min_iter=min_iter, max_iter=max_iter, n_out=n_out,
     )
 
+    mat = NamedSharding(mesh, P(None, None))
+
     def train_epoch(w, m0, Xc, Tc):
         # targets zero-padded to the padded output rows (a fixed point
         # of the sharded math, parallel/mesh.py)
@@ -504,7 +541,8 @@ def _make_tp_state(
         t_pad[:, : Tc.shape[1]] = Tc
         return ep_fn(
             w, m0,
-            jnp.asarray(Xc, dtype=dtype), jnp.asarray(t_pad),
+            dp.global_put(np.asarray(Xc, dtype=dtype), mat),
+            dp.global_put(t_pad, mat),
             alpha_j, delta_j,
         )
 
@@ -534,7 +572,24 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
 
     if conf.kernel is None or conf.tests is None or conf.type == NNType.UKN:
         return
-    if not os.path.isdir(conf.tests):
+    # census collective before filesystem-dependent early returns
+    # (multi-process TP eval is collective — see train_kernel; the
+    # missing-dir marker keeps missing-vs-empty ranks in agreement)
+    from hpnn_tpu.parallel import dist
+
+    have_dir = os.path.isdir(conf.tests)
+    census = (
+        sample_io.list_sample_files(conf.tests) if have_dir
+        else ["\x00missing"]
+    )
+    if not dist.census_consistent(census):
+        log.nn_error(
+            sys.stderr,
+            "test dir %s differs across processes (count or order)!\n",
+            conf.tests,
+        )
+        return
+    if not have_dir:
         log.nn_error(sys.stderr, "can't open test directory: %s\n", conf.tests)
         return
     dtype = _compute_dtype()
@@ -550,7 +605,7 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
         run_fn = tp.make_run_fn(mesh, len(padded), model=model, n_out=n_out)
 
         def forward(x_np):
-            x = tp.replicate(jnp.asarray(x_np, dtype=dtype), mesh)
+            x = tp.replicate(np.asarray(x_np, dtype=dtype), mesh)
             return np.asarray(run_fn(w_sh, x))[:n_out]
     else:
         weights = tuple(jnp.asarray(w) for w in weights_np)
@@ -566,8 +621,6 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
     from hpnn_tpu.utils import debug
 
     debug.device_alloc_report(tuple(w_sh))
-
-    from hpnn_tpu.parallel import dist
 
     conf.seed = dist.resolve_time_seed(conf.seed)
 
@@ -586,7 +639,7 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
     # output row persist; inputs live one 4096-row chunk at a time
     # (the previous bulk-read held the whole dir's inputs TWICE —
     # ~760 MB at a 60k×784 f64 test dir).
-    files = sample_io.list_sample_files(conf.tests)
+    files = census  # the verified listing IS the canonical file list
     n_in = weights_np[0].shape[1]
     no_batch = bool(os.environ.get("HPNN_NO_BATCH_EVAL"))
 
@@ -604,7 +657,7 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
             mesh, len(padded), model=model, n_out=n_out
         )
         return lambda xs: np.asarray(
-            run_b(w_sh, tp_mod.replicate(jnp.asarray(xs), mesh))
+            run_b(w_sh, tp_mod.replicate(xs, mesh))
         )[:, :n_out]
 
     chunk = 4096  # bound host+device memory on huge test sets

@@ -312,3 +312,37 @@ def test_two_process_cli_model_sharded(tmp_path):
     assert "BATCH EPOCH" not in outs[1]  # rank-0-only tokens
     for fname in ("kernel.opt", "kernel.tmp"):
         assert (multi / fname).read_text() == (single / fname).read_text()
+
+
+def test_two_process_cli_per_sample_tp(tmp_path):
+    """The reference's FLAGSHIP mode distributed: per-sample
+    convergence training with layer rows split across ranks
+    (`mpirun -np X train_nn`, ref: /root/reference/src/ann.c:912-936)
+    — `train_nn --mesh 1x2` (no --batch) as a 2-process cluster, each
+    process holding half of every layer's rows, must reproduce the
+    single-process 2-device run's token stream and kernel.opt byte for
+    byte (fused TP rounds: the shard_map scan runs over the
+    cross-process mesh)."""
+    single = _make_workdir(tmp_path, "single")
+    multi = _make_workdir(tmp_path, "multi")
+    args = ["-v", "-v", "--mesh", "1x2", "nn.conf"]
+    out_single = _run_cli("hpnn_tpu.cli.train_nn", args, single, _clean_env(2))
+    outs = _run_cli_cluster("hpnn_tpu.cli.train_nn", args, multi)
+    assert "N_ITER=" in out_single and "TRAINING FILE" in out_single
+    assert _tokens(outs[0]) == _tokens(out_single)
+    assert "TRAINING FILE" not in outs[1]  # rank-0-only tokens
+    assert (multi / "kernel.opt").read_text() == (
+        single / "kernel.opt").read_text()
+
+    # sharded eval under the same cluster
+    for work in (single, multi):
+        (work / "cont.conf").write_text(
+            (work / "nn.conf").read_text().replace(
+                "[init] generate", "[init] kernel.opt")
+        )
+    ev_args = ["-v", "-v", "--mesh", "1x2", "cont.conf"]
+    ev_single = _run_cli("hpnn_tpu.cli.run_nn", ev_args, single, _clean_env(2))
+    ev_outs = _run_cli_cluster("hpnn_tpu.cli.run_nn", ev_args, multi)
+    assert "[PASS]" in ev_single
+    assert _tokens(ev_outs[0]) == _tokens(ev_single)
+    assert "TESTING FILE" not in ev_outs[1]
