@@ -390,3 +390,108 @@ def test_fused_round_crash_resume(workdir, capsys, monkeypatch):
     with open("kernel.opt", "w") as fp:
         config.dump_kernel(conf2, fp)
     assert open("kernel.opt").read() == want_kernel
+
+
+def test_checkpoint_not_adopted_by_cont_round(workdir, capsys, monkeypatch):
+    """Advisor r3: with [seed] 0, a leftover crash checkpoint from a
+    generate round over the same dir/topology must NOT be silently
+    adopted by a later cont round ([init] kernel.opt) — the starting-
+    weights identity in the key keeps them apart."""
+    from hpnn_tpu import config
+    from hpnn_tpu.train import driver
+    from hpnn_tpu.utils import logging as log
+
+    conf_path = _conf(workdir)
+    state = workdir / "round.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    log.set_verbose(2)
+    try:
+        # round 0 (generate): train fully, then forge a leftover stale
+        # checkpoint by re-saving the completed round's state file
+        conf0 = config.load_conf(conf_path)
+        assert driver.train_kernel(conf0) is True
+        with open("kernel.opt", "w") as fp:
+            config.dump_kernel(conf0, fp)
+        # forge: a generate-round checkpoint at done=5, garbage weights
+        shapes = tuple(tuple(int(d) for d in np.asarray(w).shape)
+                       for w in conf0.kernel.weights)
+        key0 = driver._fuse_state_key(
+            str(workdir / "samples"), "ann", False, shapes, "generate")
+        driver._save_fuse_state(
+            str(state), key0, conf0.seed, 5, 16,
+            [np.zeros(s) for s in shapes])
+        capsys.readouterr()
+
+        # cont round with [seed] 0: must NOT adopt the generate-round
+        # checkpoint — all 20 samples train (a wrongly-adopted done=5
+        # checkpoint would skip five token lines with zeroed weights)
+        cont = workdir / "cont.conf"
+        cont.write_text(
+            open(conf_path).read()
+            .replace("[init] generate", "[init] kernel.opt")
+            .replace("[seed] 1234", "[seed] 0")
+        )
+        conf1 = config.load_conf(str(cont))
+        assert driver.train_kernel(conf1) is True
+    finally:
+        log.set_verbose(0)
+    out = capsys.readouterr().out
+    assert out.count("TRAINING FILE") == 20
+    # the cont round ran under its OWN key (the stale checkpoint was
+    # superseded, never adopted) and cleaned up after completing
+    assert not state.exists()
+
+
+def test_batch_checkpoint_key_binds_hyperparams(tmp_path, capsys,
+                                                monkeypatch):
+    """A batch checkpoint from a different batch size must not be
+    adopted (the key binds B/lr/epochs)."""
+    from hpnn_tpu.train import batch as batch_mod_local
+
+    import tests.test_batch as tb
+    from hpnn_tpu.utils import logging as log
+
+    conf = tb._conf(tmp_path)
+    state = tmp_path / "batch.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    log.set_verbose(2)
+    try:
+        # a run at B=8 leaves a mid-run checkpoint behind (kill epoch 3)
+        import jax
+
+        from hpnn_tpu.parallel import dp
+
+        real_make = dp.make_gspmd_epoch_fn
+        calls = {"n": 0}
+
+        def make_dying(*a, **kw):
+            real = real_make(*a, **kw)
+
+            def fn(*fa, **fkw):
+                calls["n"] += 1
+                if calls["n"] == 3:
+                    raise jax.errors.JaxRuntimeError("UNAVAILABLE: simulated")
+                return real(*fa, **fkw)
+
+            return fn
+
+        monkeypatch.setattr(dp, "make_gspmd_epoch_fn", make_dying)
+        with pytest.raises(jax.errors.JaxRuntimeError):
+            batch_mod_local.train_kernel_batched(
+                tb._conf_copy(conf), batch_size=8, epochs=4, mesh_spec="2x1")
+        monkeypatch.setattr(dp, "make_gspmd_epoch_fn", real_make)
+        assert state.exists()
+        capsys.readouterr()
+
+        # a B=4 run over the same dir/topology: different effective
+        # batch (the 2x1 mesh rounds to the data axis: 8 vs 4 on 2
+        # devices stays 8 vs 4), so a different key — no adoption:
+        # all 4 epochs train, numbered from 1
+        c2 = tb._conf_copy(conf)
+        assert batch_mod_local.train_kernel_batched(
+            c2, batch_size=4, epochs=4, mesh_spec="2x1")
+    finally:
+        log.set_verbose(0)
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if "BATCH EPOCH" in ln]
+    assert len(lines) == 4 and "   1 " in lines[0]
