@@ -22,3 +22,17 @@ import jax  # noqa: E402
 # use, so overriding the config here still wins.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_verbosity():
+    """Module-global verbosity must not leak across tests (a failing
+    test that set it would otherwise cascade 'NN:' output into
+    unrelated tests)."""
+    yield
+    from hpnn_tpu.utils import logging as log
+
+    log.set_verbose(0)

@@ -229,8 +229,11 @@ def test_batch_crash_resume(tmp_path, capsys, monkeypatch):
 
     from hpnn_tpu.parallel import dp
 
+    from hpnn_tpu.utils import logging as log
+
     epochs = 6
     conf = _conf(tmp_path)
+    log.set_verbose(2)  # epoch tokens print at NN_OUT (autouse reset)
     assert batch_mod.train_kernel_batched(conf, batch_size=8, epochs=epochs)
     want = capsys.readouterr().out
     want_w = [np.asarray(w).copy() for w in conf.kernel.weights]
@@ -274,6 +277,7 @@ def test_batch_crash_resume(tmp_path, capsys, monkeypatch):
         return [ln for ln in s.splitlines() if "BATCH EPOCH" in ln]
 
     # crashed run printed epochs 1-3, the resume 4-6; together = baseline
+    assert len(epoch_lines(want)) == epochs  # tokens actually emitted
     assert epoch_lines(part1) + epoch_lines(part2) == epoch_lines(want)
     assert not state.exists()  # completed run cleans up
     for a, b in zip(conf3.kernel.weights, want_w):
@@ -287,7 +291,14 @@ def test_batch_pallas_compile_fallback(tmp_path, capsys, monkeypatch):
     exactly like an unsupported topology would on TPU."""
     import jax
 
+    from hpnn_tpu.utils import logging as log
+
+    # f32 both runs: the Pallas gate requires f32 (the suite default is
+    # x64), and the baseline must share the compute dtype for its
+    # tokens to be comparable
+    monkeypatch.setenv("HPNN_DTYPE", "float32")
     conf = _conf(tmp_path)
+    log.set_verbose(2)  # epoch tokens print at NN_OUT (autouse reset)
     assert batch_mod.train_kernel_batched(
         conf, batch_size=8, epochs=2, mesh_spec="1x1")
     want = capsys.readouterr().out
@@ -299,10 +310,15 @@ def test_batch_pallas_compile_fallback(tmp_path, capsys, monkeypatch):
     conf2 = _conf(tmp_path / "run2")
     assert batch_mod.train_kernel_batched(
         conf2, batch_size=8, epochs=2, mesh_spec="1x1")
-    got = capsys.readouterr().out
+    captured = capsys.readouterr()
+    got = captured.out
+    # the fused dispatch really was attempted and really fell back
+    assert "falling back to the XLA step" in captured.err
     # same token stream and identical weights as the clean XLA run
+    want_lines = [ln for ln in want.splitlines() if "BATCH EPOCH" in ln]
+    assert len(want_lines) == 2
     assert [ln for ln in got.splitlines() if "BATCH EPOCH" in ln] == \
-        [ln for ln in want.splitlines() if "BATCH EPOCH" in ln]
+        want_lines
     for a, b in zip(conf2.kernel.weights, want_w):
         np.testing.assert_allclose(np.asarray(a), b, atol=1e-12)
 
@@ -311,9 +327,12 @@ def test_batch_stall_halves_dispatch_cap(tmp_path, capsys, monkeypatch):
     """A batch dispatch killed WITHOUT any handler running (tutorial
     timeout SIGKILL) must shrink the gather-path epochs-per-dispatch
     cap on each zero-progress resume, like the fused-round chunk."""
+    from hpnn_tpu.utils import logging as log
+
     conf = _conf(tmp_path)
     state = tmp_path / "batch.state"
     monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    log.set_verbose(2)  # epoch tokens print at NN_OUT (autouse reset)
 
     def killed_make(*a, **kw):
         def fn(*fa, **fkw):
@@ -346,8 +365,9 @@ def test_batch_stall_halves_dispatch_cap(tmp_path, capsys, monkeypatch):
     assert batch_mod.train_kernel_batched(
         c3, batch_size=8, epochs=6, mesh_spec="1x1")
     want = capsys.readouterr().out
-    assert [ln for ln in got.splitlines() if "BATCH EPOCH" in ln] == \
-        [ln for ln in want.splitlines() if "BATCH EPOCH" in ln]
+    got_lines = [ln for ln in got.splitlines() if "BATCH EPOCH" in ln]
+    want_lines = [ln for ln in want.splitlines() if "BATCH EPOCH" in ln]
+    assert len(want_lines) == 6 and got_lines == want_lines
     for a, b in zip(c2.kernel.weights, c3.kernel.weights):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
     assert not state.exists()
