@@ -267,12 +267,16 @@ def train_kernel_batched(
     weights = tuple(
         jnp.asarray(np.asarray(w), dtype=dtype) for w in conf.kernel.weights
     )
+    # resolve the learning rate ONCE, before anything keys on it (the
+    # crash-resume checkpoint key binds it; the two dispatch paths must
+    # agree on the resolved value, not one on None)
+    if lr is None:
+        lr = dp.default_lr(model, momentum)
     # one dispatch per EPOCH (lax.scan over minibatches): the per-step
     # dispatch floor (~100 ms host round-trip vs ~1 ms device work on
     # the MNIST topology) would otherwise dominate.  Single data shard:
     # samples live on device once, batches gather by index; sharded
     # data axis: host permutes and uploads per epoch.
-    n_data = mesh.shape[mesh_mod.DATA_AXIS]
     gather = n_data == 1
     # the fused Pallas batch step is OPT-IN (HPNN_PALLAS=1): the r04
     # paired slope measurement (BASELINE.md roofline section) shows it
@@ -308,9 +312,6 @@ def train_kernel_batched(
         # step is the fused Pallas kernel or dp.train_step_math, the
         # per-epoch eval+accuracy runs on device too, and only the
         # per-epoch (losses, count) scalars come home
-        if lr is None:
-            lr = dp.default_lr(model, momentum)
-
         def _math_step(w, m, Xb, Tb):
             return dp.train_step_math(
                 w, m, Xb, Tb, model=model, momentum=momentum,
