@@ -346,9 +346,11 @@ def bench_batch():
         }
 
     # FLOPs/step: fwd 2PB + bwd 4PB + loss re-forward 2PB = 8PB.
-    # Achieved rate from the SLOPE of the production dispatch (the XLA
-    # scan) — the absolute-mode number keeps ~8 us/step of tunnel
-    # amortization and is reported separately for series continuity.
+    # Achieved rate from the XLA-scan SLOPE (at this MNIST shape the
+    # two dispatches measure identical — slope section — so the
+    # XLA figure stands for both; the absolute-mode number keeps
+    # ~8 us/step of tunnel amortization and is reported separately
+    # for series continuity).
     flops_per_step = 8 * n_params * BATCH_B
     slope_med_us = slope["xla_B1024"]["median_us"]
     achieved = flops_per_step / (slope_med_us * 1e-6)
@@ -359,7 +361,11 @@ def bench_batch():
     bw_ceiling_flops = flops_per_step / (hbm_bytes_per_step / 819e9)
     out = {
         "batch_size": BATCH_B,
-        "dispatch": "xla_scan",  # production default since r04
+        # what THIS section measured (the slope section covers both
+        # dispatches; at this shape they are identical)
+        "dispatch_measured": "xla_scan",
+        # what production uses by default since r04 (BASELINE.md)
+        "production_default": "ann=pallas_fused snn=xla_scan",
         "samples_per_s": _stats(scan_sps),
         "steps_per_s": _stats(scan_stps),
         "slope": slope,

@@ -278,16 +278,19 @@ def train_kernel_batched(
     # samples live on device once, batches gather by index; sharded
     # data axis: host permutes and uploads per epoch.
     gather = n_data == 1
-    # the fused Pallas batch step is OPT-IN (HPNN_PALLAS=1): the r04
-    # paired slope measurement (BASELINE.md roofline section) shows it
-    # speed-identical to the XLA scan (21.5 vs 21.3 us/step at the
-    # MNIST topology, B=1024 — the step is HBM-bound, so on-chip fusion
-    # buys nothing the scan doesn't already have), while the XLA path
-    # has no VMEM ceiling and agrees exactly with the parity-pinned
-    # math step for SNN on hardware.  Parity of the kernel itself is
-    # still proven in tests/test_pallas.py.
-    # VMEM gate for the opt-in: batch X/T, acts+deltas scratch
-    # (2·B·Σout_l), weights (aliased in-place, counted once)
+    # Fused Pallas batch step: default for ANN, opt-in for SNN — the
+    # r04 paired slope measurements (BASELINE.md roofline section):
+    # at the MNIST shape (B=1024) the two dispatches are identical
+    # (21.6 vs 21.3 us/step; HBM-bound), at the XRD shape (B=256 BPM)
+    # the fused kernel wins +20% paired (6.6 vs 8.3 us/step) — never
+    # slower, so ANN (loss-identical trajectories) keeps it.  SNN
+    # defaults to the XLA scan, which agrees exactly with the
+    # parity-pinned math step on hardware (the kernel's exp/log
+    # lowering drifts ~1.5%/4k steps); HPNN_PALLAS=1 forces the
+    # kernel on, =0 forces the scan.  Kernel parity itself is proven
+    # in tests/test_pallas.py.
+    # VMEM gate: batch X/T, acts+deltas scratch (2·B·Σout_l), weights
+    # (aliased in-place, counted once)
     n_outs = sum(int(w.shape[0]) for w in weights)
     n_in = int(weights[0].shape[1])
     n_w = sum(int(np.asarray(w).size) for w in weights)
@@ -296,13 +299,17 @@ def train_kernel_batched(
         + 2 * B * n_outs                        # acts + deltas scratch
         + n_w * (2 if momentum else 1)
     )
+    pallas_env = os.environ.get("HPNN_PALLAS", "")
     use_pallas = (
         gather
         and mesh.devices.size == 1
         and jax.default_backend() == "tpu"
         and dtype == jnp.float32  # fused kernel is f32-only
         and vmem_bytes <= 12 * 2**20
-        and os.environ.get("HPNN_PALLAS", "0") == "1"
+        and (
+            pallas_env == "1"
+            or (pallas_env != "0" and model == "ann")
+        )
     )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -372,11 +379,17 @@ def train_kernel_batched(
     state_key = None
     state = None
     if state_path:
+        # the key binds the dispatch path too: ANN Pallas/XLA
+        # trajectories are token-identical in practice (measured at
+        # 60k scale) but not guaranteed bit-identical, so a resumed
+        # run must continue on the dispatch that wrote the checkpoint
+        # — by refusing the other dispatch's checkpoint outright
         state_key = _batch_state_key(
             conf.samples, model, momentum,
             tuple(tuple(int(d) for d in w.shape) for w in weights),
             B, lr, epochs,
-            _init_identity(conf, [np.asarray(w) for w in weights]),
+            ("pallas/" if use_pallas else "xla/")
+            + _init_identity(conf, [np.asarray(w) for w in weights]),
         )
         state = _load_fuse_state(state_path, state_key)
         if state is not None and conf.seed not in (0, int(state["seed"])):
