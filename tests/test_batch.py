@@ -46,6 +46,7 @@ def _conf(tmp_path, *, snn=False, train=NNTrain.BP, n=24):
 
 @pytest.mark.parametrize("snn,train", [
     (False, NNTrain.BP), (False, NNTrain.BPM), (True, NNTrain.BP),
+    (True, NNTrain.BPM),
 ])
 def test_batched_training_learns(tmp_path, snn, train):
     conf = _conf(tmp_path, snn=snn, train=train)
