@@ -495,3 +495,17 @@ def test_batch_checkpoint_key_binds_hyperparams(tmp_path, capsys,
     out = capsys.readouterr().out
     lines = [ln for ln in out.splitlines() if "BATCH EPOCH" in ln]
     assert len(lines) == 4 and "   1 " in lines[0]
+
+
+def test_profile_trace_writes_xplane(workdir, capsys):
+    """--profile DIR wraps the workload in a jax.profiler trace
+    (SURVEY.md §5 tracing: the XLA-native replacement for the
+    reference's external-profiler hooks) and must leave a trace
+    artifact on disk."""
+    conf = _conf(workdir)
+    tdir = workdir / "trace"
+    assert train_nn.main(["--profile", str(tdir), conf]) == 0
+    dumped = [p for p in tdir.rglob("*") if p.is_file()]
+    assert dumped, "profiler trace directory is empty"
+    assert any("xplane" in p.name or p.suffix in (".pb", ".json.gz")
+               for p in dumped), [p.name for p in dumped]
