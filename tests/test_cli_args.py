@@ -1,0 +1,91 @@
+"""CLI flag grammar: the byte-compatible single-dash grammar
+(ref: /root/reference/tests/train_nn.c:33-58) plus the TPU-side
+``--name`` extensions (cli/common.py)."""
+
+import pytest
+
+from hpnn_tpu import runtime
+from hpnn_tpu.cli import common
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    runtime.init_runtime()
+    yield
+    runtime.init_runtime()
+
+
+def test_combined_short_flags():
+    # the reference accepts combined flags: -vvx
+    assert common.parse_args(["-vvx", "nn.conf"], "t") == "nn.conf"
+    assert runtime.return_verbose() == 2
+    assert runtime.runtime().nn_dry is True
+
+
+def test_numeric_flags_inline_and_split():
+    assert common.parse_args(["-O4", "-B", "2", "-S8", "f.conf"], "t") == "f.conf"
+    assert runtime.get_omp_threads() == 4
+    assert runtime.get_omp_blas() == 2
+    assert runtime.get_cuda_streams() == 8
+
+
+def test_bad_numeric_parameter_errors(capsys):
+    assert common.parse_args(["-O", "x", "f.conf"], "t") is None
+    assert "bad -O parameter" in capsys.readouterr().err
+    assert common.parse_args(["-O"], "t") is None  # missing value
+    assert common.parse_args(["-O", "0", "f.conf"], "t") is None  # zero
+
+
+def test_stream_zero_clamps_to_one():
+    # -S 0 parses (the reference treats 0 streams as "no slicing");
+    # the advisory setter clamps to 1
+    assert common.parse_args(["-S", "0", "f.conf"], "t") == "f.conf"
+    assert runtime.get_cuda_streams() == 1
+
+
+def test_unknown_flag_and_double_filename(capsys):
+    assert common.parse_args(["-q", "f.conf"], "t") is None
+    assert common.parse_args(["a.conf", "b.conf"], "t") is None
+
+
+def test_default_conf_filename():
+    # no positional arg: the reference defaults to ./nn.conf
+    assert common.parse_args([], "t") == "./nn.conf"
+
+
+def test_help_returns_none(capsys):
+    assert common.parse_args(["-h"], "t") is None
+    # help goes to stdout, like the reference's printf help
+    assert "usage" in capsys.readouterr().out.lower()
+
+
+def test_extract_long_opts_forms():
+    rest, opts = common.extract_long_opts(
+        ["-v", "--batch", "64", "--mesh=2x4", "x.conf"],
+        valued=("batch", "mesh"),
+    )
+    assert rest == ["-v", "x.conf"]
+    assert opts == {"batch": "64", "mesh": "2x4"}
+
+
+def test_extract_long_opts_errors(capsys):
+    rest, opts = common.extract_long_opts(["--nope"], valued=("batch",))
+    assert rest is None and opts is None
+    rest, opts = common.extract_long_opts(["--batch"], valued=("batch",))
+    assert rest is None  # missing value
+
+
+def test_validate_long_opts():
+    assert common.validate_long_opts({"batch": "64", "mesh": "2x4",
+                                      "lr": "0.5"})
+    assert not common.validate_long_opts({"batch": "0"})
+    assert not common.validate_long_opts({"mesh": "2x"})
+    assert not common.validate_long_opts({"lr": "-1"})
+    assert not common.validate_long_opts({"lr": "abc"})
+
+
+def test_tp_mesh_rejects_data_axis():
+    with pytest.raises(ValueError, match="1xM"):
+        common.tp_mesh("2x4")
+    m = common.tp_mesh("1x4")
+    assert m.shape == {"data": 1, "model": 4}
