@@ -26,11 +26,16 @@ comparisons should use it, not `value`, across the r02→r03 boundary.
 
 Since r04, A/B comparisons are PAIRED: fused-vs-streaming repeats are
 interleaved (per-round ratio), and the batch section adds slope
-timing — us/step from (t(13120 steps) − t(320 steps))/Δsteps, which
-cancels the ~65–110 ms per-dispatch tunnel round-trip — with
-Pallas/XLA/B=2048 variants interleaved round-robin and a paired
-per-round delta.  The absolute 8000-step scan numbers continue the
-r01–r03 series (they include ~8 us/step of amortized tunnel cost).
+timing — us/step from (t(big) − t(small))/Δsteps, which cancels the
+~65–110 ms per-dispatch tunnel round-trip.  Slope variants run
+interleaved round-robin: MNIST-shape Pallas/XLA at B=1024 (13120 vs
+320 steps) and XLA at B=2048 everywhere, plus — on TPU only, where
+the paired counterpart exists — the XRD shape (851-230-230 BPM,
+B=256, 51520 vs 320 steps: its ~3x-faster step needs longer
+dispatches to resolve).  Each pair reports a per-round paired delta
+(`paired_pallas_vs_xla_pct`, `paired_xrd_pallas_vs_xla_pct`).  The
+absolute 8000-step scan numbers continue the r01–r03 series (they
+include ~8 us/step of amortized tunnel cost).
 
 Baseline: a locally-built reference (gcc -O2 -fopenmp -D_OMP, best
 this toolchain allows — no cblas, no MPI) with the tutorial's -O4 -B4
@@ -266,32 +271,39 @@ def bench_batch():
     # (the r03 best-of-N comparison was retracted for exactly this).
     from jax import lax
 
-    def make_multi(step_math, B):
+    def make_multi(step_math):
         @jax.jit
-        def fn(weights, X, T, idx_all):
-            def epoch(w, ix_e):
-                def body(c, ix):
-                    w2, _m, l = step_math(c, X[ix], T[ix])
-                    return w2, l
-                return lax.scan(body, w, ix_e)
-            return lax.scan(epoch, weights, idx_all)
+        def fn(state, X, T, idx_all):
+            def epoch(c, ix_e):
+                def body(cc, ix):
+                    w2, m2, l = step_math(cc[0], cc[1], X[ix], T[ix])
+                    return (w2, m2), l
+                return lax.scan(body, c, ix_e)
+            return lax.scan(epoch, state, idx_all)
         return fn
 
-    def xla_step(w, Xb, Tb):
-        return dp.train_step_math(w, (), Xb, Tb, model="ann",
-                                  momentum=False, lr=0.001, alpha=0.2)
+    def xla_step(momentum, lr):
+        def f(w, m, Xb, Tb):
+            return dp.train_step_math(w, m, Xb, Tb, model="ann",
+                                      momentum=momentum, lr=lr, alpha=0.2)
+        return f
 
-    def pal_step(w, Xb, Tb):
+    def pal_step(momentum, lr):
         from hpnn_tpu.ops import pallas_train
 
-        return pallas_train.train_step_fused_batch(
-            w, (), Xb, Tb, model="ann", momentum=False, lr=0.001, alpha=0.2)
+        def f(w, m, Xb, Tb):
+            return pallas_train.train_step_fused_batch(
+                w, m, Xb, Tb, model="ann", momentum=momentum, lr=lr,
+                alpha=0.2)
+        return f
 
-    def slope_setup(B, step_math):
+    def slope_setup(ws, B, n_in_t, n_out_t, step_math, momentum,
+                    e_big=SLOPE_E_BIG):
+        dw = tuple(jnp.zeros_like(w) for w in ws) if momentum else ()
         rngb = np.random.RandomState(11)
-        Xb = jnp.asarray(rngb.uniform(0, 255, (B, 784)).astype(np.float32))
-        Tb_np = np.full((B, 10), -1.0, dtype=np.float32)
-        Tb_np[np.arange(B), rngb.randint(0, 10, B)] = 1.0
+        Xb = jnp.asarray(rngb.uniform(0, 255, (B, n_in_t)).astype(np.float32))
+        Tb_np = np.full((B, n_out_t), -1.0, dtype=np.float32)
+        Tb_np[np.arange(B), rngb.randint(0, n_out_t, B)] = 1.0
         Tb = jnp.asarray(Tb_np)
 
         def mk_idx(E):
@@ -301,49 +313,74 @@ def bench_batch():
                     for s in range(SLOPE_S)]) for e in range(E)]),
                 dtype=jnp.int32)
 
-        fn = make_multi(step_math, B)
-        i_s, i_b = mk_idx(SLOPE_E_SMALL), mk_idx(SLOPE_E_BIG)
+        fn = make_multi(step_math)
+        i_s, i_b = mk_idx(SLOPE_E_SMALL), mk_idx(e_big)
 
         def once(ix):
             t0 = time.perf_counter()
-            r = fn(weights, Xb, Tb, ix)
+            r = fn((ws, dw), Xb, Tb, ix)
             np.asarray(r[1]).ravel()
             return time.perf_counter() - t0
 
         once(i_s)
         once(i_b)  # warm both shapes
-        d = (SLOPE_E_BIG - SLOPE_E_SMALL) * SLOPE_S
+        d = (e_big - SLOPE_E_SMALL) * SLOPE_S
 
         def sample():
             return 1e6 * (once(i_b) - once(i_s)) / d
 
-        return sample
+        return B, sample
 
-    variants = {"xla_B1024": slope_setup(BATCH_B, xla_step)}
+    variants = {
+        "xla_B1024": slope_setup(
+            weights, BATCH_B, 784, 10, xla_step(False, 0.001), False),
+        "xla_B2048": slope_setup(
+            weights, 2 * BATCH_B, 784, 10, xla_step(False, 0.001), False),
+    }
     if jax.default_backend() == "tpu":
-        variants["pallas_B1024"] = slope_setup(BATCH_B, pal_step)
-    variants["xla_B2048"] = slope_setup(2 * BATCH_B, xla_step)
+        # the XRD pair (851-230-230 BPM, B=256) exists for the
+        # Pallas-vs-XLA comparison at the shape where the kernel wins
+        # — paired, so TPU-only (off-TPU it would be an expensive
+        # unpaired workload with no counterpart); longer dispatches
+        # because its ~3x-faster step would under-resolve the delta
+        kx, _ = kernel_mod.generate(10958, 851, [230], 230)
+        w_xrd = tuple(
+            jnp.asarray(np.asarray(w), dtype=jnp.float32)
+            for w in kx.weights
+        )
+        XRD_B, XRD_E_BIG = 256, 805
+        variants["pallas_B1024"] = slope_setup(
+            weights, BATCH_B, 784, 10, pal_step(False, 0.001), False)
+        variants["xrd_xla_B256"] = slope_setup(
+            w_xrd, XRD_B, 851, 230, xla_step(True, 0.4), True,
+            e_big=XRD_E_BIG)
+        variants["xrd_pallas_B256"] = slope_setup(
+            w_xrd, XRD_B, 851, 230, pal_step(True, 0.4), True,
+            e_big=XRD_E_BIG)
     slope_us = {k: [] for k in variants}
     for _ in range(SLOPE_REPEATS):
-        for k, sample in variants.items():  # interleaved: paired rounds
+        for k, (_B, sample) in variants.items():  # interleaved: paired
             slope_us[k].append(sample())
     slope = {
         k: {"us_per_step": [round(v, 2) for v in vals],
             "median_us": round(statistics.median(vals), 2),
             "samples_per_s_M": round(
-                (2 * BATCH_B if k.endswith("2048") else BATCH_B)
-                / statistics.median(vals), 2)}
+                variants[k][0] / statistics.median(vals), 2)}
         for k, vals in slope_us.items()
     }
-    if "pallas_B1024" in slope_us:
-        deltas = [
-            round(100.0 * (b - a) / b, 2)
-            for a, b in zip(slope_us["pallas_B1024"], slope_us["xla_B1024"])
-        ]  # + = pallas faster per paired round
-        slope["paired_pallas_vs_xla_pct"] = {
-            "per_round": deltas,
-            "median": round(statistics.median(deltas), 2),
-        }
+    for tag, a_key, b_key in (
+        ("paired_pallas_vs_xla_pct", "pallas_B1024", "xla_B1024"),
+        ("paired_xrd_pallas_vs_xla_pct", "xrd_pallas_B256", "xrd_xla_B256"),
+    ):
+        if a_key in slope_us:
+            deltas = [
+                round(100.0 * (b - a) / b, 2)
+                for a, b in zip(slope_us[a_key], slope_us[b_key])
+            ]  # + = pallas faster per paired round
+            slope[tag] = {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            }
 
     # FLOPs/step: fwd 2PB + bwd 4PB + loss re-forward 2PB = 8PB.
     # Achieved rate from the XLA-scan SLOPE (at this MNIST shape the
