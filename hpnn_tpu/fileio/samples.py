@@ -120,18 +120,21 @@ def _skip_blank(raw: bytes, pos: int, limit: int) -> int:
     return pos
 
 
-def read_dir(directory: str):
+def read_dir(directory: str, files=None):
     """Read every sample in readdir order → (names, X, T) stacked arrays.
 
     The batched drivers' bulk loader; skips unreadable/malformed files
-    the same way the per-sample driver does.
+    the same way the per-sample driver does.  Pass the already-listed
+    census as ``files`` so the caller's census check, the bulk read,
+    and any later shuffle all iterate ONE listing — a re-list here
+    could race file creation (same discipline as driver._shuffled_files).
     """
     import sys
 
     from hpnn_tpu.utils import logging as log
 
     names, xs, ts = [], [], []
-    for name in list_sample_files(directory):
+    for name in (list_sample_files(directory) if files is None else files):
         s = read_sample(os.path.join(directory, name))
         if s is None:
             continue

@@ -453,6 +453,106 @@ def train_step_fused_batch(
     return new_w, new_dw, results[n_state][0]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("model", "momentum", "lr", "alpha", "batch",
+                              "interpret")
+)
+def train_step_fused_banked(
+    weights,
+    dw,
+    X_bank,
+    T_bank,
+    k,
+    *,
+    batch: int,
+    model: str = "ann",
+    momentum: bool = False,
+    lr: float | None = None,
+    alpha: float = 0.2,
+    interpret: bool = False,
+):
+    """The fused minibatch step reading its batch straight from an
+    on-device bank: identical math to :func:`train_step_fused_batch`,
+    but the ``(B, n)`` X/T operands are replaced by the FULL padded
+    bank (``(n_steps·B, n)``, HBM-resident) plus a scalar block index
+    ``k`` — Pallas DMAs exactly rows ``[k·B, (k+1)·B)`` into VMEM via
+    a scalar-prefetched ``index_map``.
+
+    This removes the per-step gather materialization entirely: the
+    BASELINE.md roofline charges the ``X[ix]`` path 6.4 MB/step of
+    gather read+write ON TOP of the step's own 3.2 MB batch read; here
+    the step's block fetch IS the only X traffic.  The bank must be
+    permuted (once per epoch, device-side) so that sequential blocks
+    are that epoch's minibatches — ``bank[perm][kB:(k+1)B]`` equals
+    the gather path's ``X[idx_k]`` bitwise, so trajectories are
+    unchanged.
+
+    ``k`` is a shape-(1,) int32 array (the scan carries it as a traced
+    scalar index).  Returns (weights, dw, loss).
+    """
+    n_layers = len(weights)
+    if lr is None:
+        from hpnn_tpu.parallel import dp
+
+        lr = dp.default_lr(model, momentum)
+    weights = tuple(jnp.asarray(wl, dtype=_F32) for wl in weights)
+    dw = tuple(jnp.asarray(m, dtype=_F32) for m in dw) if momentum else ()
+    X_bank = jnp.asarray(X_bank, dtype=_F32)
+    T_bank = jnp.asarray(T_bank, dtype=_F32)
+    B = int(batch)
+    n_in = X_bank.shape[1]
+    n_out = T_bank.shape[1]
+
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem1 = pl.BlockSpec(memory_space=pltpu.SMEM)
+    n_state = n_layers * (2 if momentum else 1)
+    out_shape = (
+        tuple(jax.ShapeDtypeStruct(wl.shape, _F32) for wl in weights)
+        + (tuple(jax.ShapeDtypeStruct(m.shape, _F32) for m in dw)
+           if momentum else ())
+        + (jax.ShapeDtypeStruct((1,), _F32),)  # loss
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((B, n_in), lambda i, k_ref: (k_ref[0], 0)),
+            pl.BlockSpec((B, n_out), lambda i, k_ref: (k_ref[0], 0)),
+        ] + [vmem] * n_state,
+        out_specs=tuple(vmem for _ in range(n_state)) + (smem1,),
+        scratch_shapes=[
+            pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights
+        ] + [pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights],
+    )
+    # alias indices count the scalar-prefetch operand too: inputs are
+    # (k, X_bank, T_bank, state...) — state starts at 3
+    aliases = {3 + i: i for i in range(n_state)}
+
+    def kernel(k_ref, *refs):  # k consumed by the index_map only
+        del k_ref
+        _batch_step_kernel(
+            *refs,
+            n_layers=n_layers,
+            model=model,
+            momentum=momentum,
+            lr=float(lr),
+            alpha=float(alpha),
+            inv_b=1.0 / B,
+        )
+
+    results = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid_spec=grid_spec,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(jnp.asarray(k, dtype=jnp.int32).reshape(1), X_bank, T_bank,
+      *weights, *dw)
+    new_w = tuple(results[:n_layers])
+    new_dw = tuple(results[n_layers : 2 * n_layers]) if momentum else ()
+    return new_w, new_dw, results[n_state][0]
+
+
 def make_pallas_epoch_fn(weights, *, model: str = "ann",
                          momentum: bool = False,
                          lr: float | None = None, alpha: float = 0.2,

@@ -184,6 +184,60 @@ def make_multi_epoch_fn(step_fn, count_fn):
     return jax.jit(run)
 
 
+def make_multi_epoch_bank_fn(step_fn, count_fn, n_steps: int, *,
+                             banked: bool):
+    """Bank-mode twin of :func:`make_multi_epoch_fn` — the roofline
+    lever: instead of gathering ``X[ix]`` per STEP (6.4 MB/step of
+    read+write on the MNIST shape, BASELINE.md), each epoch permutes
+    the bank ONCE device-side and the steps read sequential B-row
+    blocks.  ``bank[perm][kB:(k+1)B] == X[idx_k]`` bitwise, so the
+    trajectories are the gather path's exactly.
+
+    run(weights, dw, X, T, perms[E, n_rows]) ->
+        (weights, dw, losses[E, S], counts[E])
+
+    ``banked=True``: step_fn(w, m, Xp, Tp, k) is the Pallas kernel
+    reading block ``k`` straight from the HBM bank via a scalar-
+    prefetched index_map (pallas_train.train_step_fused_banked) —
+    zero per-step copy.  ``banked=False``: XLA scan over the reshaped
+    ``(S, B, n)`` bank (the scan's leading-axis slice replaces the
+    gather).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def run(weights, dw, X, T, perms):
+        def epoch(carry, perm_e):
+            w, m = carry
+            Xp = X[perm_e]
+            Tp = T[perm_e]
+            if banked:
+                def body(c, k):
+                    w2, m2 = c
+                    w2, m2, l = step_fn(w2, m2, Xp, Tp, k)
+                    return (w2, m2), l
+
+                (w, m), losses = lax.scan(
+                    body, (w, m), jnp.arange(n_steps, dtype=jnp.int32))
+            else:
+                Xs = Xp.reshape(n_steps, -1, X.shape[1])
+                Ts = Tp.reshape(n_steps, -1, T.shape[1])
+
+                def body(c, xt):
+                    w2, m2 = c
+                    w2, m2, l = step_fn(w2, m2, xt[0], xt[1])
+                    return (w2, m2), l
+
+                (w, m), losses = lax.scan(body, (w, m), (Xs, Ts))
+            return (w, m), (losses, count_fn(w, X, T))
+
+        (weights, dw), (losses, counts) = lax.scan(epoch, (weights, dw), perms)
+        return weights, dw, losses, counts
+
+    return jax.jit(run)
+
+
 def accuracy_counts(out: np.ndarray, T: np.ndarray, model: str) -> int:
     """Vectorized argmax-vs-target, same rules as the per-sample eval
     (train/driver.py: _first_argmax / _last_above quirks)."""
@@ -191,17 +245,21 @@ def accuracy_counts(out: np.ndarray, T: np.ndarray, model: str) -> int:
 
 
 def _batch_state_key(sample_dir, model, momentum, shapes, B, lr, epochs,
-                     init_key=""):
+                     init_key="", names=None):
     """Round identity for batch-mode crash-resume checkpoints: the
     fused-round scheme (driver._fuse_state_key — census + network +
     starting-weights identity) extended with the batch hyperparameters
     (a checkpoint from a different B/lr/epoch-count protocol is a
-    different run)."""
+    different run).  ``names`` threads the census the run actually
+    trained over — without it the key would re-list the dir, and a file
+    created/removed between crash and resume would silently restart
+    instead of resuming."""
     from hpnn_tpu.train.driver import _fuse_state_key
 
     return _fuse_state_key(
         sample_dir, model, momentum, shapes,
         f"batch/B{B}/lr{lr}/E{epochs}/{init_key}",
+        names=names,
     )
 
 
@@ -234,10 +292,14 @@ def train_kernel_batched(
     # a missing dir hashes as a marker so missing-vs-empty ranks
     # disagree here (both erroring) rather than down-stream
     have_dir = os.path.isdir(conf.samples)
-    names, X, T = sample_io.read_dir(conf.samples) if have_dir else ([], None, None)
+    all_files = sample_io.list_sample_files(conf.samples) if have_dir else []
+    names, X, T = (
+        sample_io.read_dir(conf.samples, files=all_files)
+        if have_dir else ([], None, None)
+    )
     from hpnn_tpu.parallel import dist
 
-    if not dist.census_consistent(names if have_dir else ["\x00missing"]):
+    if not dist.census_consistent(all_files if have_dir else ["\x00missing"]):
         log.nn_error(
             sys.stderr,
             "sample dir %s differs across processes (count or order)!\n",
@@ -278,6 +340,16 @@ def train_kernel_batched(
     # samples live on device once, batches gather by index; sharded
     # data axis: host permutes and uploads per epoch.
     gather = n_data == 1
+    # Bank data path (single data shard): per-epoch device-side
+    # permutation into a scan-ordered bank instead of a per-step
+    # ``X[ix]`` gather — same batches bitwise (``bank[perm]`` block k
+    # IS ``X[idx_k]``), but the step reads its minibatch contiguously:
+    # under the Pallas dispatch the banked kernel block-fetches
+    # straight from the HBM bank (zero per-step copy — the r04
+    # roofline's 6.4 MB/step of gather read+write disappears from the
+    # steps).  Paired slope measurements in BASELINE.md (r05) set the
+    # default; HPNN_BANK=0 forces the legacy per-step gather.
+    use_bank = gather and os.environ.get("HPNN_BANK", "1") != "0"
     # Fused Pallas batch step: default for ANN, opt-in for SNN — the
     # r04 paired slope measurements (BASELINE.md roofline section):
     # at the MNIST shape (B=1024) the two dispatches are identical
@@ -314,6 +386,8 @@ def train_kernel_batched(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     rep = NamedSharding(mesh, P())
+    pad = (-n) % B
+    n_steps = (n + pad) // B
     if gather:
         # single data shard: fuse MANY epochs per dispatch — the inner
         # step is the fused Pallas kernel or dp.train_step_math, the
@@ -325,17 +399,34 @@ def train_kernel_batched(
                 lr=lr, alpha=0.2,
             )
 
-        if use_pallas:
-            from hpnn_tpu.ops import pallas_train
+        count_fn = make_device_count_fn(model=model)
 
-            def step_fn(w, m, Xb, Tb):
-                return pallas_train.train_step_fused_batch(
-                    w, m, Xb, Tb, model=model, momentum=momentum,
-                    lr=lr, alpha=0.2,
+        def _build_multi_fn(with_pallas):
+            if with_pallas:
+                from hpnn_tpu.ops import pallas_train
+
+                if use_bank:
+                    def step_fn(w, m, Xp, Tp, k):
+                        return pallas_train.train_step_fused_banked(
+                            w, m, Xp, Tp, k, batch=B, model=model,
+                            momentum=momentum, lr=lr, alpha=0.2,
+                        )
+                else:
+                    def step_fn(w, m, Xb, Tb):
+                        return pallas_train.train_step_fused_batch(
+                            w, m, Xb, Tb, model=model, momentum=momentum,
+                            lr=lr, alpha=0.2,
+                        )
+            else:
+                step_fn = _math_step
+            if use_bank:
+                return make_multi_epoch_bank_fn(
+                    step_fn, count_fn, n_steps,
+                    banked=with_pallas,
                 )
-        else:
-            step_fn = _math_step
-        multi_fn = make_multi_epoch_fn(step_fn, make_device_count_fn(model=model))
+            return make_multi_epoch_fn(step_fn, count_fn)
+
+        multi_fn = _build_multi_fn(use_pallas)
     else:
         epoch_fn = dp.make_gspmd_epoch_fn(
             mesh, weights, model=model, momentum=momentum, lr=lr, alpha=0.2,
@@ -378,20 +469,44 @@ def train_kernel_batched(
         state_path = None
     state_key = None
     state = None
-    if state_path:
+
+    def _make_state_key(with_pallas):
         # the key binds the dispatch path too: ANN Pallas/XLA
         # trajectories are token-identical in practice (measured at
         # 60k scale) but not guaranteed bit-identical, so a resumed
         # run must continue on the dispatch that wrote the checkpoint
-        # — by refusing the other dispatch's checkpoint outright
-        state_key = _batch_state_key(
+        # — by refusing the other dispatch's checkpoint outright.
+        # The bank/gather data path is tagged too (same batches
+        # bitwise, but the XLA fusion of slice-vs-gather is not
+        # guaranteed identical), and the census names are threaded so
+        # the key never re-lists the dir (advisor r4).
+        return _batch_state_key(
             conf.samples, model, momentum,
             tuple(tuple(int(d) for d in w.shape) for w in weights),
             B, lr, epochs,
-            ("pallas/" if use_pallas else "xla/")
+            ("pallas" if with_pallas else "xla")
+            + ("-bank/" if use_bank else "/")
             + _init_identity(conf, [np.asarray(w) for w in weights]),
+            names=names,
         )
+
+    if state_path:
+        state_key = _make_state_key(use_pallas)
         state = _load_fuse_state(state_path, state_key)
+        if gather and state is None and use_pallas:
+            # a crashed predecessor may have hit the Mosaic-failure
+            # fallback mid-run and re-keyed its checkpoint to the XLA
+            # dispatch: adopt it AND stay on that dispatch, so the
+            # resumed trajectory provably continues on the dispatch
+            # that wrote it (advisor r4).  Seed-checked BEFORE the
+            # dispatch flip: a fresh explicitly-seeded run must not be
+            # silently demoted to XLA by a stale checkpoint it is
+            # about to discard anyway.
+            alt_key = _make_state_key(False)
+            alt = _load_fuse_state(state_path, alt_key)
+            if alt is not None and conf.seed in (0, int(alt["seed"])):
+                state_key, state, use_pallas = alt_key, alt, False
+                multi_fn = _build_multi_fn(False)
         if state is not None and conf.seed not in (0, int(state["seed"])):
             state = None  # different seeded run requested: start over
     done_epochs = 0
@@ -425,7 +540,6 @@ def train_kernel_batched(
             resume_done=resume_done)
 
     loss = float("nan")
-    pad = (-n) % B
     if pad:
         # no silent caps: the tail wrap re-trains `pad` sample slots
         # per epoch so every jitted batch keeps its static shape.
@@ -455,7 +569,6 @@ def train_kernel_batched(
         # np.resize repeats the permutation as needed even when B > 2n
         return np.resize(order, n + pad) if pad else order
 
-    n_steps = (n + pad) // B
     for _ in range(done_epochs):
         # resume: replay the consumed permutation draws (one per epoch)
         # so the remaining epochs shuffle exactly as the crashed run
@@ -484,10 +597,15 @@ def train_kernel_batched(
         timed_cap = None
         while epoch < epochs:
             e_block = min(e_cap, epochs - epoch)
+            # bank mode scans sequential blocks of the per-epoch
+            # permuted bank, so only the flat (E, n_rows) permutations
+            # go up; gather mode keeps the (E, S, B) index shape
+            perm_block = np.stack([
+                epoch_order() for _ in range(e_block)
+            ]).astype(np.int32)
             idx = dp.global_put(
-                np.stack([
-                    epoch_order().reshape(n_steps, B) for _ in range(e_block)
-                ]).astype(np.int32),
+                perm_block if use_bank
+                else perm_block.reshape(e_block, n_steps, B),
                 rep,
             )
             t0 = _time.monotonic()
@@ -513,9 +631,16 @@ def train_kernel_batched(
                         "falling back to the XLA step\n",
                         type(exc).__name__,
                     )
-                    multi_fn = make_multi_epoch_fn(
-                        _math_step, make_device_count_fn(model=model))
+                    multi_fn = _build_multi_fn(False)
                     use_pallas = False
+                    # re-key the checkpoint to the dispatch actually
+                    # running from here on and persist immediately:
+                    # a resume must NOT recompute use_pallas=True,
+                    # adopt the old key, and continue an XLA-trained
+                    # trajectory on the Pallas dispatch (advisor r4)
+                    if state_path:
+                        state_key = _make_state_key(False)
+                        _save_state(epoch, cap=e_cap)
                     # rewind the RNG so the retried block reuses the
                     # SAME permutations the failed dispatch consumed
                     rng = np.random.RandomState(conf.seed & 0x7FFFFFFF)
@@ -569,12 +694,20 @@ def run_kernel_batched(conf: NNConf) -> None:
     if conf.kernel is None or conf.tests is None or conf.type == NNType.UKN:
         return
     # census collective before any filesystem-dependent early return
-    # (see train_kernel_batched)
+    # (see train_kernel_batched).  The census covers the FULL listing
+    # (readable or not) and that same listing later drives the shuffle
+    # — one readdir for all three uses, mirroring driver.run_kernel
+    # (advisor r4: a re-list for the shuffle could race file creation
+    # and diverge across ranks).
     have_dir = os.path.isdir(conf.tests)
-    names, X, T = sample_io.read_dir(conf.tests) if have_dir else ([], None, None)
+    all_files = sample_io.list_sample_files(conf.tests) if have_dir else []
+    names, X, T = (
+        sample_io.read_dir(conf.tests, files=all_files)
+        if have_dir else ([], None, None)
+    )
     from hpnn_tpu.parallel import dist
 
-    if not dist.census_consistent(names if have_dir else ["\x00missing"]):
+    if not dist.census_consistent(all_files if have_dir else ["\x00missing"]):
         log.nn_error(
             sys.stderr,
             "test dir %s differs across processes (count or order)!\n",
@@ -603,7 +736,6 @@ def run_kernel_batched(conf: NNConf) -> None:
 
     _resolve_seed(conf)
     row_of = {name: i for i, name in enumerate(names)}
-    all_files = sample_io.list_sample_files(conf.tests)
     for idx in shuffled_order(conf.seed, len(all_files)):
         name = all_files[idx]
         log.nn_out(sys.stdout, "TESTING FILE: %16.16s\t", name)

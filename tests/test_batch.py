@@ -342,6 +342,7 @@ def test_batch_stall_halves_dispatch_cap(tmp_path, capsys, monkeypatch):
         return fn
 
     monkeypatch.setattr(batch_mod, "make_multi_epoch_fn", killed_make)
+    monkeypatch.setattr(batch_mod, "make_multi_epoch_bank_fn", killed_make)
     # n=24, B=8 -> n_steps=3 -> heuristic cap 65536//3 = 21845
     expect = [21845, 10922, 5461]
     for want_cap in expect:
@@ -372,3 +373,32 @@ def test_batch_stall_halves_dispatch_cap(tmp_path, capsys, monkeypatch):
     for a, b in zip(c2.kernel.weights, c3.kernel.weights):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
     assert not state.exists()
+
+
+@pytest.mark.parametrize("snn,train", [
+    (False, NNTrain.BP), (True, NNTrain.BPM),
+])
+def test_bank_matches_gather_trajectory(tmp_path, capsys, monkeypatch, snn,
+                                        train):
+    """The bank data path (per-epoch device permute + sequential
+    blocks) trains on the SAME batches as the per-step gather path —
+    token streams and final kernels must match bitwise."""
+    from hpnn_tpu.utils import logging as log
+
+    conf = _conf(tmp_path, snn=snn, train=train)
+    log.set_verbose(2)
+
+    monkeypatch.setenv("HPNN_BANK", "0")
+    c1 = _conf_copy(conf)
+    assert batch_mod.train_kernel_batched(c1, batch_size=8, epochs=6)
+    gather_out = capsys.readouterr().out
+
+    monkeypatch.setenv("HPNN_BANK", "1")
+    c2 = _conf_copy(conf)
+    assert batch_mod.train_kernel_batched(c2, batch_size=8, epochs=6)
+    bank_out = capsys.readouterr().out
+
+    assert "BATCH EPOCH" in gather_out
+    assert gather_out == bank_out
+    for a, b in zip(c1.kernel.weights, c2.kernel.weights):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

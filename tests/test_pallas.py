@@ -129,3 +129,37 @@ def test_pallas_epoch_matches_gspmd_epoch():
     )
     for a, b in zip(gw, rw):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("model,momentum", [
+    ("ann", False), ("ann", True), ("snn", False), ("snn", True),
+])
+def test_banked_step_matches_direct(model, momentum):
+    """Banked fused step (HBM bank + scalar-prefetch block index) is
+    BITWISE the direct fused step on every block — the bank data path
+    must not change trajectories (train/batch.py's roofline lever)."""
+    weights, _, _ = _setup(21, 16, [12], 5)
+    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    rng = np.random.RandomState(7)
+    B, S = 8, 4
+    X = jnp.asarray(rng.uniform(-1, 1, (S * B, 16)), dtype=jnp.float32)
+    T = np.full((S * B, 5), -1.0, dtype=np.float32)
+    T[np.arange(S * B), rng.randint(0, 5, S * B)] = 1.0
+    T = jnp.asarray(T)
+
+    w1, m1 = weights, dw
+    w2, m2 = weights, dw
+    for k in range(S):
+        w1, m1, l1 = pallas_train.train_step_fused_batch(
+            w1, m1, X[k * B:(k + 1) * B], T[k * B:(k + 1) * B],
+            model=model, momentum=momentum, lr=0.05, interpret=True,
+        )
+        w2, m2, l2 = pallas_train.train_step_fused_banked(
+            w2, m2, X, T, jnp.int32(k), batch=B,
+            model=model, momentum=momentum, lr=0.05, interpret=True,
+        )
+        assert float(l1) == float(l2)
+    for a, b in zip(w1, w2):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(m1, m2):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

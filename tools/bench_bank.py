@@ -1,0 +1,242 @@
+"""Paired slope A/B of batch-mode data paths (r05 roofline work,
+VERDICT r04 #1).
+
+Variants (all through the production step implementations):
+
+* ``gather-xla`` / ``gather-pallas`` — today's per-step ``X[ix]``
+  gather (train/batch.make_multi_epoch_fn).
+* ``bank-xla`` / ``bank-pallas`` — the VERDICT-prescribed per-epoch
+  device-side permutation into a scan-ordered bank
+  (make_multi_epoch_bank_fn).  Arithmetically the permute (full-bank
+  read+write once per epoch) costs exactly what the per-step gather
+  did, so this can only win on per-step op overhead.
+* ``order-xla`` / ``order-pallas`` — shuffle-once bank + per-epoch
+  random block ORDER: zero per-epoch data movement; the Pallas banked
+  kernel block-fetches straight from HBM (the only true traffic
+  reduction).  Changes the SGD schedule: batch composition is fixed
+  at upload (order + boundary rotation only).
+* ``seq-xla`` / ``seq-pallas`` — no shuffle at all (sequential
+  blocks): the step-cost floor.
+
+Method: production multi-epoch dispatches at two epoch counts with
+index arrays pre-placed on device; Δt/Δsteps per repeat cancels the
+tunnel's per-dispatch round trip (BASELINE.md timing discipline);
+variants interleave round-robin for paired per-repeat deltas; fences
+are host transfers.  The per-epoch on-device eval (count_fn) is
+included — production pays it.
+
+Run on the real chip:  python tools/bench_bank.py [--quick] [--mnist-only]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_variants(*, n_in, n_hidden, n_out, B, S, momentum, model="ann"):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.ops import pallas_train
+    from hpnn_tpu.parallel import dp
+    from hpnn_tpu.train import batch as batch_mod
+
+    k, _ = kernel_mod.generate(10958, n_in, [n_hidden], n_out)
+    weights = tuple(jnp.asarray(np.asarray(w), jnp.float32) for w in k.weights)
+    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    lr = dp.default_lr(model, momentum)
+
+    def math_step(w, m, Xb, Tb):
+        return dp.train_step_math(w, m, Xb, Tb, model=model,
+                                  momentum=momentum, lr=lr, alpha=0.2)
+
+    def pallas_step(w, m, Xb, Tb):
+        return pallas_train.train_step_fused_batch(
+            w, m, Xb, Tb, model=model, momentum=momentum, lr=lr, alpha=0.2)
+
+    def banked_step(w, m, Xp, Tp, kk):
+        return pallas_train.train_step_fused_banked(
+            w, m, Xp, Tp, kk, batch=B, model=model, momentum=momentum,
+            lr=lr, alpha=0.2)
+
+    count_fn = batch_mod.make_device_count_fn(model=model)
+
+    def make_order_fn(banked):
+        """orders (E, S) int32 block ids; no per-epoch data movement."""
+
+        def run(weights, dw, X, T, orders):
+            Xr = X.reshape(S, B, n_in)
+            Tr = T.reshape(S, B, n_out)
+
+            def epoch(carry, ord_e):
+                w, m = carry
+
+                def body(c, kk):
+                    w2, m2 = c
+                    if banked:
+                        w2, m2, l = banked_step(w2, m2, X, T, kk)
+                    else:
+                        w2, m2, l = math_step(w2, m2, Xr[kk], Tr[kk])
+                    return (w2, m2), l
+
+                (w, m), losses = lax.scan(body, (w, m), ord_e)
+                return (w, m), (losses, count_fn(w, X, T))
+
+            (weights, dw), (losses, counts) = lax.scan(
+                epoch, (weights, dw), orders)
+            return weights, dw, losses, counts
+
+        return jax.jit(run)
+
+    def make_seq_fn(banked):
+        """No shuffle at all: the step-cost floor.  idx is a dummy
+        (E,) epoch counter so the harness shape logic stays shared."""
+
+        def run(weights, dw, X, T, epochs_dummy):
+            Xr = X.reshape(S, B, n_in)
+            Tr = T.reshape(S, B, n_out)
+
+            def epoch(carry, _e):
+                w, m = carry
+                if banked:
+                    def body(c, kk):
+                        w2, m2 = c
+                        w2, m2, l = banked_step(w2, m2, X, T, kk)
+                        return (w2, m2), l
+
+                    (w, m), losses = lax.scan(
+                        body, (w, m), jnp.arange(S, dtype=jnp.int32))
+                else:
+                    def body2(c, xt):
+                        w2, m2 = c
+                        w2, m2, l = math_step(w2, m2, xt[0], xt[1])
+                        return (w2, m2), l
+
+                    (w, m), losses = lax.scan(body2, (w, m), (Xr, Tr))
+                return (w, m), (losses, count_fn(w, X, T))
+
+            (weights, dw), (losses, counts) = lax.scan(
+                epoch, (weights, dw), epochs_dummy)
+            return weights, dw, losses, counts
+
+        return jax.jit(run)
+
+    fns = {
+        "gather-xla": batch_mod.make_multi_epoch_fn(math_step, count_fn),
+        "gather-pallas": batch_mod.make_multi_epoch_fn(pallas_step, count_fn),
+        "bank-xla": batch_mod.make_multi_epoch_bank_fn(
+            math_step, count_fn, S, banked=False),
+        "bank-pallas": batch_mod.make_multi_epoch_bank_fn(
+            banked_step, count_fn, S, banked=True),
+        "order-xla": make_order_fn(False),
+        "order-pallas": make_order_fn(True),
+        "seq-xla": make_seq_fn(False),
+        "seq-pallas": make_seq_fn(True),
+    }
+
+    rng = np.random.RandomState(7)
+    n_rows = S * B
+    X = jnp.asarray(rng.uniform(-1, 1, (n_rows, n_in)), jnp.float32)
+    T = np.full((n_rows, n_out), -1.0, np.float32)
+    T[np.arange(n_rows), rng.randint(0, n_out, n_rows)] = 1.0
+    T = jnp.asarray(T)
+    return weights, dw, X, T, fns
+
+
+def run_shape(label, *, n_in, n_hidden, n_out, B, S, momentum,
+              e_small, e_big, repeats, variants=None):
+    import jax
+    import jax.numpy as jnp
+
+    weights, dw, X, T, fns = make_variants(
+        n_in=n_in, n_hidden=n_hidden, n_out=n_out, B=B, S=S,
+        momentum=momentum)
+    if variants:
+        fns = {k: v for k, v in fns.items() if k in variants}
+    n_rows = S * B
+    rng = np.random.RandomState(3)
+
+    def put_idx(E, name):
+        if name.startswith("bank"):
+            arr = np.stack([rng.permutation(n_rows) for _ in range(E)])
+        elif name.startswith("gather"):
+            arr = np.stack([rng.permutation(n_rows).reshape(S, B)
+                            for _ in range(E)])
+        elif name.startswith("order"):
+            arr = np.stack([rng.permutation(S) for _ in range(E)])
+        else:  # seq
+            arr = np.arange(E)
+        return jax.device_put(jnp.asarray(arr.astype(np.int32)))
+
+    idx = {
+        name: {E: put_idx(E, name) for E in (e_small, e_big)}
+        for name in fns
+    }
+
+    def timed(fn, E, name):
+        t0 = time.perf_counter()
+        w, m, losses, counts = fn(weights, dw, X, T, idx[name][E])
+        np.asarray(counts[-1])  # host-transfer fence
+        return time.perf_counter() - t0
+
+    # warm both shapes of every variant (compile excluded from timing)
+    for name in list(fns):
+        for E in (e_small, e_big):
+            try:
+                timed(fns[name], E, name)
+            except Exception as exc:
+                print(f"{label} {name}: FAILED {type(exc).__name__}: {exc}",
+                      file=sys.stderr)
+                fns[name] = None
+    fns = {n: f for n, f in fns.items() if f is not None}
+
+    d_steps = (e_big - e_small) * S
+    slopes = {n: [] for n in fns}
+    for _ in range(repeats):
+        for name, fn in fns.items():
+            ts = timed(fn, e_small, name)
+            tb = timed(fn, e_big, name)
+            slopes[name].append((tb - ts) / d_steps * 1e6)
+    out = {}
+    for name, ss in slopes.items():
+        ss_s = sorted(ss)
+        out[name] = {
+            "us_per_step_median": round(ss_s[len(ss_s) // 2], 3),
+            "us_per_step_all": [round(v, 3) for v in ss_s],
+        }
+    base = slopes.get("gather-pallas")
+    if base:
+        for name, ss in slopes.items():
+            if name == "gather-pallas":
+                continue
+            deltas = sorted((b - a) / b * 100.0 for a, b in zip(ss, base))
+            out[name]["paired_gain_vs_gather_pallas_pct"] = [
+                round(d, 1) for d in deltas
+            ]
+            out[name]["paired_gain_median_pct"] = round(
+                deltas[len(deltas) // 2], 1)
+    print(json.dumps({"shape": label, "B": B, "steps_per_epoch": S,
+                      "results": out}, indent=1), flush=True)
+    return out
+
+
+def main():
+    quick = "--quick" in sys.argv
+    rep = 2 if quick else 5
+    run_shape("mnist 784-300-10 BP", n_in=784, n_hidden=300, n_out=10,
+              B=1024, S=60, momentum=False,
+              e_small=5, e_big=55 if quick else 225, repeats=rep)
+    if "--mnist-only" not in sys.argv:
+        run_shape("xrd 851-230-230 BPM", n_in=851, n_hidden=230, n_out=230,
+                  B=256, S=15, momentum=True,
+                  e_small=20, e_big=220 if quick else 900, repeats=rep)
+
+
+if __name__ == "__main__":
+    main()

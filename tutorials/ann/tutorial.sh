@@ -26,6 +26,12 @@ for arg in "$@"; do
     esac
 done
 
+# fresh-container preflight: offline editable install (pip's default
+# build isolation needs network — README "Install (offline)")
+command -v train_nn >/dev/null || {
+    echo "train_nn not on PATH - installing $SCRIPT_DIR/../.. (offline editable)"
+    pip install -e "$SCRIPT_DIR/../.." --no-build-isolation -q || exit 1
+}
 for tool in pdif train_nn run_nn; do
     command -v "$tool" >/dev/null || { echo "Can't find $tool!"; exit 1; }
 done

@@ -19,6 +19,11 @@ for arg in "$@"; do
     --batch) BATCH_MODE=y;;
     esac
 done
+# fresh-container preflight (see tutorial.sh): offline editable install
+command -v train_nn >/dev/null || {
+    echo "train_nn not on PATH - installing $SCRIPT_DIR/../.. (offline editable)"
+    pip install -e "$SCRIPT_DIR/../.." --no-build-isolation -q || exit 1
+}
 cd mnist || { echo "run tutorial.sh first (needs ./mnist)"; exit 1; }
 
 cat > mnist_snn.conf <<'EOF'

@@ -28,6 +28,14 @@ for arg in "$@"; do
     esac
 done
 
+# fresh-container preflight: the CLIs come from the editable install,
+# and pip's default build isolation needs network to fetch setuptools —
+# --no-build-isolation builds with the baked-in one instead (README
+# "Install (offline)")
+command -v train_nn >/dev/null || {
+    echo "train_nn not on PATH - installing $SCRIPT_DIR/../.. (offline editable)"
+    pip install -e "$SCRIPT_DIR/../.." --no-build-isolation -q || exit 1
+}
 for tool in pmnist train_nn run_nn; do
     command -v "$tool" >/dev/null || { echo "Can't find $tool!"; exit 1; }
 done
