@@ -388,6 +388,32 @@ def bench_batch():
                 "median": round(statistics.median(deltas), 2),
             }
 
+    # -- r05: PRODUCTION data-path slope at realistic bank size -------
+    # The slope variants above keep their one-batch bank for r01–r04
+    # series continuity, but a 3.2 MB bank can go VMEM-resident and
+    # delete the HBM traffic being modeled (BASELINE.md r05
+    # correction).  This section measures what `train_nn --batch`
+    # actually dispatches — 60-step epochs over an S·B-row HBM bank,
+    # per-epoch on-device accuracy eval included — for the r04 default
+    # (per-step gather) and the r05 default (bankR=8 + block order).
+    prod_slope = None
+    if jax.default_backend() == "tpu":
+        import contextlib
+        import io
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_bank
+
+        with contextlib.redirect_stdout(io.StringIO()):
+            prod_slope = bench_bank.run_shape(
+                "mnist-prod", n_in=784, n_hidden=300, n_out=10,
+                B=BATCH_B, S=60, momentum=False,
+                e_small=8, e_big=208, repeats=SLOPE_REPEATS,
+                variants={"gather-pallas", "bankR-pallas"},
+            )
+
     # FLOPs/step: fwd 2PB + bwd 4PB + loss re-forward 2PB = 8PB.
     # Achieved rate from the XLA-scan SLOPE (at this MNIST shape the
     # two dispatches measure identical — slope section — so the
@@ -422,6 +448,8 @@ def bench_batch():
             "steps_per_s": _stats(disp_stps),
         },
     }
+    if prod_slope is not None:
+        out["prod_slope_60k_bank"] = prod_slope
     return out
 
 
@@ -551,6 +579,11 @@ def main(argv=None) -> None:
         for tag, v in b["slope"].items():
             if isinstance(v, dict) and "median" in v and "median_us" not in v:
                 compact[tag] = v["median"]
+        if "prod_slope_60k_bank" in b:
+            compact["prod_us_per_step"] = {
+                k: v["us_per_step_median"]
+                for k, v in b["prod_slope_60k_bank"].items()
+            }
     compact["detail_file"] = detail_path
     print(json.dumps(compact))
 

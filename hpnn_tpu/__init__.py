@@ -19,7 +19,10 @@ scientific workflows, with
 """
 
 from hpnn_tpu import runtime
-from hpnn_tpu.config import NNConf, NNType, NNTrain, load_conf, dump_conf
+from hpnn_tpu.config import (
+    NNConf, NNType, NNTrain, load_conf, dump_conf,
+    generate_kernel, load_kernel, dump_kernel,
+)
 from hpnn_tpu.models.kernel import Kernel
 
 __version__ = "0.1.0"
@@ -32,4 +35,35 @@ __all__ = [
     "load_conf",
     "dump_conf",
     "Kernel",
+    "generate_kernel",
+    "load_kernel",
+    "dump_kernel",
+    # lazy (jax-importing) exports, see __getattr__
+    "train_kernel",
+    "run_kernel",
+    "train_kernel_batched",
+    "run_kernel_batched",
+    "read_sample",
 ]
+
+# The execute-ops (`_NN(train,kernel)` / `_NN(run,kernel)`,
+# ref: /root/reference/include/libhpnn.h:210-215) import jax through
+# the training stack; they resolve lazily so ``import hpnn_tpu`` stays
+# light for host programs that only manipulate confs/kernels.  The
+# full _NN(a,b) -> Python parity map is docs/api.md.
+_LAZY = {
+    "train_kernel": ("hpnn_tpu.train.driver", "train_kernel"),
+    "run_kernel": ("hpnn_tpu.train.driver", "run_kernel"),
+    "train_kernel_batched": ("hpnn_tpu.train.batch", "train_kernel_batched"),
+    "run_kernel_batched": ("hpnn_tpu.train.batch", "run_kernel_batched"),
+    "read_sample": ("hpnn_tpu.fileio.samples", "read_sample"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'hpnn_tpu' has no attribute {name!r}")

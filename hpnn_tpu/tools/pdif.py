@@ -31,6 +31,78 @@ MAX_THETA = 90.0
 
 _FLOAT = re.compile(r"[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?")
 
+# strtod at the START of the remainder (the reference's GET_DOUBLE
+# chain walks the line; a stray word between numbers fails the row,
+# unlike a find-anywhere regex)
+_LEAD_FLOAT = re.compile(
+    r"[ \t\n\r\f\v]*([-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?)")
+
+# The 119-symbol element table of the reference's atom.def
+# (crystallographic constants; ref: tutorials/ann/atom.def:3).  Index
+# IS the atomic number Z ("X"=0 unknown).
+ATOM_SYMB = [
+    "X", "H", "He", "Li", "Be", "B", "C", "N", "O", "F", "Ne", "Na",
+    "Mg", "Al", "Si", "P", "S", "Cl", "Ar", "K", "Ca", "Sc", "Ti", "V",
+    "Cr", "Mn", "Fe", "Co", "Ni", "Cu", "Zn", "Ga", "Ge", "As", "Se",
+    "Br", "Kr", "Rb", "Sr", "Y", "Zr", "Nb", "Mo", "Tc", "Ru", "Rh",
+    "Pd", "Ag", "Cd", "In", "Sn", "Sb", "Te", "I", "Xe", "Cs", "Ba",
+    "La", "Ce", "Pr", "Nd", "Pm", "Sm", "Eu", "Gd", "Tb", "Dy", "Ho",
+    "Er", "Tm", "Yb", "Lu", "Hf", "Ta", "W", "Re", "Os", "Ir", "Pt",
+    "Au", "Hg", "Tl", "Pb", "Bi", "Po", "At", "Rn", "Fr", "Ra", "Ac",
+    "Th", "Pa", "U", "Np", "Pu", "Am", "Cm", "Bk", "Cf", "Es", "Fm",
+    "Md", "No", "Lr", "Rf", "Db", "Sg", "Bh", "Hs", "Mt", "Ds", "Rg",
+    "Cn", "Nh", "Fl", "Mc", "Lv", "Ts", "Og",
+]
+
+
+def _match_atom(s: str) -> int | None:
+    """The reference's ATM_IS_EQ walk (file_dif.c:171-206): descending
+    index over atom_symb; a 1-char symbol needs a following blank, a
+    2-char symbol needs its second char.  Returns Z or None.
+
+    Faithfulness notes: the C starts at atom_symb[MAX_ATOMS] — one
+    PAST the table (out-of-bounds read, practically never a match) —
+    we start at the last real entry; the "I before In"/"S before Si"/
+    "B before Be" remaps are unreachable given ATM_IS_EQ (a 1-char
+    symbol can only match when followed by a blank), so they are not
+    reproduced."""
+    c0 = s[0] if s else ""
+    c1 = s[1] if len(s) > 1 else "\n"
+    for idx in range(len(ATOM_SYMB) - 1, 0, -1):
+        sym = ATOM_SYMB[idx]
+        if c0 != sym[0]:
+            continue
+        if len(sym) == 1:
+            if c1.isspace():
+                return idx
+        elif c1 == sym[1]:
+            return idx
+    return None
+
+
+def _parse_atom_row(s: str) -> str:
+    """One ATOM row → "atom" | "skip" | "fail" (file_dif.c:166-268).
+
+    An element hit consumes exactly 2 chars, then must GET_DOUBLE five
+    fields (x y z occ B); any parse failure FAILs the whole file (the
+    reference's ASSERT_GOTO → read_dif returns NULL and prepare_dif
+    skips the file).  A row matching NO element is silently skipped:
+    the OH/Wa/Ow/Oh→O and unknown-X arms sit behind ``if(idx<0)`` with
+    ``UINT idx`` (file_dif.c:46,214) — dead code, mirrored as written,
+    not as commented."""
+    if _match_atom(s) is None:
+        return "skip"
+    rest = s[2:]
+    for _ in range(5):
+        m = _LEAD_FLOAT.match(rest)
+        if m is None:
+            return "fail"
+        rest = rest[m.end():]
+        # GET_DOUBLE chains advance one char past the number, then
+        # SKIP_BLANK — subsumed by the leading-blank strtod match
+        rest = rest[1:]
+    return "atom"
+
 
 class Dif:
     def __init__(self):
@@ -102,15 +174,22 @@ def read_dif(path: str) -> Dif | None:
             if dif.space == 0:
                 sys.stdout.write(f"#DBG: NO_space group = {sym}\n")
         if "ATOM" in line:
-            # atom rows follow until a line starts with a digit/blank
+            # atom rows follow while the line's first graphic char is a
+            # non-digit; each row goes through the element-symbol walk
+            # (file_dif.c:166-268 — mechanism-for-mechanism, see
+            # _parse_atom_row): a malformed matched row fails the WHOLE
+            # file, an unmatched symbol is silently skipped
             i += 1
             while i < n:
                 s = lines[i].lstrip(" \t")
-                if not s or s[0].isdigit() or not s[0].isprintable():
+                c = s[:1]
+                if not c or c.isdigit() or c.isspace() or not c.isprintable():
                     break
-                if not s.split():
-                    break
-                dif.natoms += 1
+                res = _parse_atom_row(s)
+                if res == "fail":
+                    return None
+                if res == "atom":
+                    dif.natoms += 1
                 i += 1
             continue
         if "WAVELENGTH" in line:

@@ -186,54 +186,69 @@ def make_multi_epoch_fn(step_fn, count_fn):
 
 def make_multi_epoch_bank_fn(step_fn, count_fn, n_steps: int, *,
                              banked: bool):
-    """Bank-mode twin of :func:`make_multi_epoch_fn` — the roofline
-    lever: instead of gathering ``X[ix]`` per STEP (6.4 MB/step of
-    read+write on the MNIST shape, BASELINE.md), each epoch permutes
-    the bank ONCE device-side and the steps read sequential B-row
-    blocks.  ``bank[perm][kB:(k+1)B] == X[idx_k]`` bitwise, so the
-    trajectories are the gather path's exactly.
+    """Bank-mode twin of :func:`make_multi_epoch_fn` — the r05
+    roofline lever.  The per-step ``X[ix]`` gather (6.4 MB/step of
+    read+write at the MNIST shape) is replaced by (a) a device-side
+    bank permutation once per REFRESH GROUP of epochs and (b) a
+    per-epoch random block ORDER, so the steps read whole B-row
+    blocks with no per-step gather.  Paired slope measurements
+    (BASELINE.md r05): the per-epoch-permute variant costs exactly
+    what the per-step gather did (same bytes), while the block-order
+    path runs within ~3% of the no-shuffle floor — +24–26% over the
+    r04 default at the MNIST shape.
 
-    run(weights, dw, X, T, perms[E, n_rows]) ->
-        (weights, dw, losses[E, S], counts[E])
+    run(weights, dw, X, T, perms[G, n_rows], orders[G, R, S]) ->
+        (weights, dw, losses[G·R, S], counts[G·R])
+
+    Group g trains epochs [g·R, (g+1)·R) on ``X[perms[g]]``; epoch r
+    visits blocks in the ``orders[g, r]`` sequence.  With R=1 and
+    sequential orders this is EXACTLY the legacy gather trajectory
+    (``bank[perm][kB:(k+1)B] == X[idx_k]`` bitwise) — the parity
+    configuration; R>1 trades composition-refresh frequency for
+    bandwidth (the SGD schedule changes; acceptance bar is final
+    accuracy, like everything in batch mode).
 
     ``banked=True``: step_fn(w, m, Xp, Tp, k) is the Pallas kernel
     reading block ``k`` straight from the HBM bank via a scalar-
     prefetched index_map (pallas_train.train_step_fused_banked) —
-    zero per-step copy.  ``banked=False``: XLA scan over the reshaped
-    ``(S, B, n)`` bank (the scan's leading-axis slice replaces the
-    gather).
+    zero per-step copy.  ``banked=False``: the XLA step on the
+    block-indexed slice of the reshaped ``(S, B, n)`` bank.
     """
     import jax
-    import jax.numpy as jnp
     from jax import lax
 
-    def run(weights, dw, X, T, perms):
-        def epoch(carry, perm_e):
+    def run(weights, dw, X, T, perms, orders):
+        def group(carry, pe):
             w, m = carry
-            Xp = X[perm_e]
-            Tp = T[perm_e]
-            if banked:
-                def body(c, k):
-                    w2, m2 = c
-                    w2, m2, l = step_fn(w2, m2, Xp, Tp, k)
-                    return (w2, m2), l
-
-                (w, m), losses = lax.scan(
-                    body, (w, m), jnp.arange(n_steps, dtype=jnp.int32))
-            else:
+            perm_g, ord_g = pe
+            Xp = X[perm_g]
+            Tp = T[perm_g]
+            if not banked:
                 Xs = Xp.reshape(n_steps, -1, X.shape[1])
                 Ts = Tp.reshape(n_steps, -1, T.shape[1])
 
-                def body(c, xt):
-                    w2, m2 = c
-                    w2, m2, l = step_fn(w2, m2, xt[0], xt[1])
-                    return (w2, m2), l
+            def epoch(c, ord_e):
+                w2, m2 = c
 
-                (w, m), losses = lax.scan(body, (w, m), (Xs, Ts))
-            return (w, m), (losses, count_fn(w, X, T))
+                def body(cc, k):
+                    w3, m3 = cc
+                    if banked:
+                        w3, m3, l = step_fn(w3, m3, Xp, Tp, k)
+                    else:
+                        w3, m3, l = step_fn(w3, m3, Xs[k], Ts[k])
+                    return (w3, m3), l
 
-        (weights, dw), (losses, counts) = lax.scan(epoch, (weights, dw), perms)
-        return weights, dw, losses, counts
+                (w2, m2), losses = lax.scan(body, (w2, m2), ord_e)
+                return (w2, m2), (losses, count_fn(w2, X, T))
+
+            (w, m), (losses, counts) = lax.scan(epoch, (w, m), ord_g)
+            return (w, m), (losses, counts)
+
+        (weights, dw), (losses, counts) = lax.scan(
+            group, (weights, dw), (perms, orders))
+        n_epochs = losses.shape[0] * losses.shape[1]
+        return (weights, dw,
+                losses.reshape(n_epochs, -1), counts.reshape(n_epochs))
 
     return jax.jit(run)
 
@@ -340,27 +355,43 @@ def train_kernel_batched(
     # samples live on device once, batches gather by index; sharded
     # data axis: host permutes and uploads per epoch.
     gather = n_data == 1
-    # Bank data path (single data shard): per-epoch device-side
-    # permutation into a scan-ordered bank instead of a per-step
-    # ``X[ix]`` gather — same batches bitwise (``bank[perm]`` block k
-    # IS ``X[idx_k]``), but the step reads its minibatch contiguously:
-    # under the Pallas dispatch the banked kernel block-fetches
-    # straight from the HBM bank (zero per-step copy — the r04
-    # roofline's 6.4 MB/step of gather read+write disappears from the
-    # steps).  Paired slope measurements in BASELINE.md (r05) set the
-    # default; HPNN_BANK=0 forces the legacy per-step gather.
+    # Bank data path (single data shard): the bank is permuted
+    # device-side once per REFRESH GROUP of HPNN_BANK_REFRESH epochs
+    # (default 8) and each epoch visits whole B-row blocks in a fresh
+    # random order — no per-step ``X[ix]`` gather.  Paired slope
+    # measurements (BASELINE.md r05): per-epoch permutation costs
+    # exactly what the per-step gather did (same bytes), while the
+    # block-order path runs within ~3% of the no-shuffle floor —
+    # +24–26% over the r04 default at the MNIST shape.  The SGD
+    # schedule differs from the legacy gather (composition refreshes
+    # every R epochs instead of every epoch; order reshuffles every
+    # epoch) — validated at 60k protocol scale (BASELINE.md).
+    # HPNN_BANK=0 forces the legacy per-step gather;
+    # HPNN_BANK_REFRESH=1 refreshes composition every epoch with
+    # sequential block order — EXACTLY the legacy trajectories
+    # (``bank[perm][kB:(k+1)B] == X[idx_k]`` bitwise, parity-tested).
     use_bank = gather and os.environ.get("HPNN_BANK", "1") != "0"
+    bank_refresh = (
+        max(1, int(os.environ.get("HPNN_BANK_REFRESH", "8")))
+        if use_bank else 0
+    )
     # Fused Pallas batch step: default for ANN, opt-in for SNN — the
-    # r04 paired slope measurements (BASELINE.md roofline section):
-    # at the MNIST shape (B=1024) the two dispatches are identical
-    # (21.6 vs 21.3 us/step; HBM-bound), at the XRD shape (B=256 BPM)
-    # the fused kernel wins +20% paired (6.6 vs 8.3 us/step) — never
-    # slower, so ANN (loss-identical trajectories) keeps it.  SNN
-    # defaults to the XLA scan, which agrees exactly with the
-    # parity-pinned math step on hardware (the kernel's exp/log
-    # lowering drifts ~1.5%/4k steps); HPNN_PALLAS=1 forces the
-    # kernel on, =0 forces the scan.  Kernel parity itself is proven
-    # in tests/test_pallas.py.
+    # r05 paired slope measurements at realistic bank sizes
+    # (BASELINE.md): on the bank path the kernel matches XLA at the
+    # MNIST shape and wins +15-20% at the XRD shape, so ANN keeps it.
+    # SNN defaults to the XLA scan: the kernel's trajectories diverge
+    # slowly from the parity-pinned math step on hardware, and the r05
+    # root-cause isolation (BASELINE.md "SNN kernel divergence")
+    # pinned it to Mosaic-vs-XLA ROW-SUM REDUCTION ORDER in the
+    # softmax denominator — exp/log/tanh and the dots are bitwise
+    # identical on hardware; ANN has no row reduction in its forward,
+    # hence its bitwise-equal trajectories.  Neither order is more
+    # correct (each is <=1-ulp-per-sum rounding; measured bound:
+    # ~8.5e-5 mean loss gap after 4k steps, identical accuracy), but
+    # only one can match the recorded XLA token stream, so the pinned
+    # step stays the SNN default.  HPNN_PALLAS=1 forces the kernel
+    # on, =0 forces the scan.  Kernel parity itself is proven in
+    # tests/test_pallas.py (interpret mode, where reductions agree).
     # VMEM gate: batch X/T, acts+deltas scratch (2·B·Σout_l), weights
     # (aliased in-place, counted once)
     n_outs = sum(int(w.shape[0]) for w in weights)
@@ -485,7 +516,7 @@ def train_kernel_batched(
             tuple(tuple(int(d) for d in w.shape) for w in weights),
             B, lr, epochs,
             ("pallas" if with_pallas else "xla")
-            + ("-bank/" if use_bank else "/")
+            + (f"-bank{bank_refresh}/" if use_bank else "/")
             + _init_identity(conf, [np.asarray(w) for w in weights]),
             names=names,
         )
@@ -563,17 +594,43 @@ def train_kernel_batched(
         )
         log.flush()
 
-    def epoch_order():
+    # most recent bank permutation: a sub-R dispatch block (shrunken
+    # survival cap) can start mid-refresh-group and must reuse the
+    # group's permutation instead of drawing a new one
+    cur_perm = [None]
+
+    def draw_perm():
         order = rng.permutation(n)
         # wrap the tail so every batch is full (static shapes for jit);
         # np.resize repeats the permutation as needed even when B > 2n
-        return np.resize(order, n + pad) if pad else order
+        p = np.resize(order, n + pad) if pad else order
+        cur_perm[0] = p
+        return p
 
-    for _ in range(done_epochs):
-        # resume: replay the consumed permutation draws (one per epoch)
-        # so the remaining epochs shuffle exactly as the crashed run
-        # would have; their tokens were already printed by it
-        epoch_order()
+    def draw_order():
+        # bank mode's per-epoch block order; at refresh=1 the freshly
+        # permuted bank makes any fixed order a random batching, so
+        # sequential blocks keep the legacy-gather trajectory exactly
+        if bank_refresh == 1:
+            return np.arange(n_steps)
+        return rng.permutation(n_steps)
+
+    def replay_epoch(e):
+        # consume exactly the RNG draws epoch ``e`` consumed, so a
+        # resume (or the Mosaic-fallback rewind) shuffles the
+        # remaining epochs exactly as the original run would have
+        if use_bank:
+            if e % bank_refresh == 0:
+                draw_perm()
+            if bank_refresh > 1:
+                draw_order()
+        else:
+            draw_perm()
+
+    for e in range(done_epochs):
+        # resume: tokens for these were already printed by the
+        # crashed run
+        replay_epoch(e)
     if gather:
         # cap the epochs per dispatch (the tunneled worker kills very
         # long dispatches, ~100 s observed).  The first blocks use a
@@ -586,6 +643,14 @@ def train_kernel_batched(
         e_cap = max(1, 65536 // max(1, n_steps))
         if cap_hint:
             e_cap = min(e_cap, cap_hint)
+        if use_bank and e_cap >= bank_refresh:
+            # whole refresh groups per dispatch block while the cap
+            # allows; a cap shrunk below R (stall halving) stays AS IS
+            # — clamping it back up would retry the same over-budget
+            # block forever, defeating the halving escape.  Sub-R
+            # blocks never straddle a group boundary (see the block
+            # builder), so the replay's e % R rule still holds.
+            e_cap = (e_cap // bank_refresh) * bank_refresh
         # mark this position as resumed (and cover a SIGKILL during
         # the very first dispatch): a next resume that finds `done`
         # unchanged halves the cap instead of retrying the same
@@ -597,21 +662,47 @@ def train_kernel_batched(
         timed_cap = None
         while epoch < epochs:
             e_block = min(e_cap, epochs - epoch)
-            # bank mode scans sequential blocks of the per-epoch
-            # permuted bank, so only the flat (E, n_rows) permutations
-            # go up; gather mode keeps the (E, S, B) index shape
-            perm_block = np.stack([
-                epoch_order() for _ in range(e_block)
-            ]).astype(np.int32)
-            idx = dp.global_put(
-                perm_block if use_bank
-                else perm_block.reshape(e_block, n_steps, B),
-                rep,
-            )
+            if use_bank:
+                start_off = epoch % bank_refresh
+                if start_off:
+                    # sub-R survival cap left us mid-group: finish the
+                    # CURRENT group (bank permutation = cur_perm, no
+                    # fresh draw — the replay rule draws only at group
+                    # boundaries) without straddling the boundary
+                    r_eff = min(e_block, bank_refresh - start_off)
+                    n_groups, e_block = 1, r_eff
+                    perms_l = [cur_perm[0]]
+                    orders_l = [[draw_order() for _ in range(r_eff)]]
+                elif e_block >= bank_refresh:
+                    # aligned: whole groups; a sub-R tail runs as its
+                    # own dispatch on the next loop pass
+                    n_groups = e_block // bank_refresh
+                    r_eff = bank_refresh
+                    e_block = n_groups * r_eff
+                    perms_l, orders_l = [], []
+                    for _g in range(n_groups):
+                        perms_l.append(draw_perm())
+                        orders_l.append(
+                            [draw_order() for _ in range(r_eff)])
+                else:
+                    # aligned sub-R block (shrunken cap or short tail)
+                    n_groups, r_eff = 1, e_block
+                    perms_l = [draw_perm()]
+                    orders_l = [[draw_order() for _ in range(r_eff)]]
+                data_args = (
+                    dp.global_put(np.asarray(perms_l, dtype=np.int32), rep),
+                    dp.global_put(np.asarray(orders_l, dtype=np.int32), rep),
+                )
+            else:
+                data_args = (dp.global_put(
+                    np.stack([draw_perm() for _ in range(e_block)]
+                             ).astype(np.int32).reshape(e_block, n_steps, B),
+                    rep,
+                ),)
             t0 = _time.monotonic()
             try:
                 w_sh, dw_sh, losses, counts = multi_fn(
-                    w_sh, dw_sh, X_dev, T_dev, idx)
+                    w_sh, dw_sh, X_dev, T_dev, *data_args)
                 losses = dp.host_fetch(losses, mesh)
                 counts = dp.host_fetch(counts, mesh)
             except Exception as exc:
@@ -644,8 +735,8 @@ def train_kernel_batched(
                     # rewind the RNG so the retried block reuses the
                     # SAME permutations the failed dispatch consumed
                     rng = np.random.RandomState(conf.seed & 0x7FFFFFFF)
-                    for _ in range(epoch):
-                        epoch_order()
+                    for e in range(epoch):
+                        replay_epoch(e)
                     continue
                 raise
             dt = _time.monotonic() - t0
@@ -653,15 +744,26 @@ def train_kernel_batched(
                 # first compile-free block: freeze the time-based cap
                 timed_cap = max(1, int(budget_s * e_block / max(dt, 1e-3)))
                 e_cap = min(e_cap, timed_cap)
+                if use_bank and e_cap >= bank_refresh:
+                    e_cap = (e_cap // bank_refresh) * bank_refresh
             block_i += 1
             for e in range(e_block):
                 epoch += 1
                 loss = float(losses[e].mean())
                 print_epoch(epoch, loss, int(counts[e]))
+            from hpnn_tpu.utils import trace as trace_mod
+
+            # per-BLOCK weight trace (the multi-epoch scan returns only
+            # the final weights; per-epoch snapshots would defeat the
+            # fused dispatch).  enabled() gate BEFORE the host_fetch —
+            # the fetch is the cost the knob controls
+            if trace_mod.enabled():
+                trace_mod.trace(f"w@{epoch}", [dp.host_fetch(w, mesh)
+                                               for w in w_sh])
             _save_state(epoch, cap=e_cap)
     else:
         for epoch in range(done_epochs + 1, epochs + 1):
-            order = epoch_order()
+            order = draw_perm()
             Xe = Xd[order].reshape(n_steps, B, -1)
             Te = Td[order].reshape(n_steps, B, -1)
             Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
@@ -670,6 +772,11 @@ def train_kernel_batched(
             out = np.asarray(eval_fn(w_sh, X_eval))
             okc = accuracy_counts(out, T, model)
             print_epoch(epoch, loss, okc)
+            from hpnn_tpu.utils import trace as trace_mod
+
+            if trace_mod.enabled():
+                trace_mod.trace(f"w@{epoch}", [dp.host_fetch(w, mesh)
+                                               for w in w_sh])
             _save_state(epoch)
     jax.block_until_ready(w_sh)
     conf.kernel = kernel_mod.Kernel(
@@ -734,6 +841,8 @@ def run_kernel_batched(conf: NNConf) -> None:
     from hpnn_tpu.train.driver import print_verdict
     from hpnn_tpu.utils.glibc_random import shuffled_order
 
+    from hpnn_tpu.utils import trace as trace_mod
+
     _resolve_seed(conf)
     row_of = {name: i for i, name in enumerate(names)}
     for idx in shuffled_order(conf.seed, len(all_files)):
@@ -743,4 +852,5 @@ def run_kernel_batched(conf: NNConf) -> None:
         if i is None:  # unreadable/malformed: header only, no verdict
             continue
         print_verdict(out[i], T[i], model)
+        trace_mod.trace(f"out@{name}", [out[i]])
     log.flush()

@@ -302,6 +302,9 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                     )
                 raise
             done += int(Xc.shape[0])
+            from hpnn_tpu.utils import trace as trace_mod
+
+            trace_mod.trace(f"w@{done}", weights)
             if state_path:
                 host_w = tuple(np.asarray(w) for w in weights)
                 _save_fuse_state(
@@ -326,7 +329,9 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 for f in files
             )
         )
-        for fname, sample in pairs:
+        from hpnn_tpu.utils import trace as trace_mod
+
+        for i, (fname, sample) in enumerate(pairs):
             log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
             if sample is None:
                 continue
@@ -336,6 +341,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             res = train_one(weights, dw, tr_in, tr_out)
             weights, dw = res.weights, res.dw
             _print_train_tokens(res, model, momentum)
+            trace_mod.trace(f"w@{i + 1}", weights)
     if tp_state is not None:
         from hpnn_tpu.parallel import dp, mesh as mesh_mod
 
@@ -695,16 +701,21 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
 
     from hpnn_tpu.utils.glibc_random import shuffled_order
 
+    from hpnn_tpu.utils import trace as trace_mod
+
     for idx in shuffled_order(conf.seed, len(files)):
         fname = files[idx]
         log.nn_out(sys.stdout, "TESTING FILE: %16.16s\t", fname)
         if fname in bad:
             continue
         if fname in out_of:
-            print_verdict(out_of[fname], targets[fname], model)
+            o = out_of[fname]
+            print_verdict(o, targets[fname], model)
         else:
             tr_in, tr_out = odd[fname]
-            print_verdict(forward(tr_in), tr_out, model)
+            o = forward(tr_in)
+            print_verdict(o, tr_out, model)
+        trace_mod.trace(f"out@{fname}", [o])
         log.flush()
 
 

@@ -1,0 +1,47 @@
+"""DBG_TRACE twin — the reference's cross-backend numeric oracle.
+
+The reference instruments its kernels with abs-sum traces to compare
+backends (``DBG_TRACE`` sum-print `#DBG: acc=%.15f`,
+ref: include/libhpnn/ann.h:29-33; CUDA ``cublasDasum`` variant,
+ref: include/libhpnn/common.h:486-490), and its ChangeLog pins the
+cross-backend agreement bars with them (≤1e-14 data vectors, ≤1e-12
+weight matrices).  This is the TPU/CPU twin: set ``HPNN_TRACE=1`` and
+every driver emits
+
+    #DBG: acc[<tag>/<layer>]=<abs-sum>
+
+lines to stdout — per sample (streaming per-sample path), per fused
+chunk, per batch dispatch block, and per eval output vector — on any
+platform/dtype, so an f32-TPU run can be diffed line-for-line against
+an f64-CPU parity run of the same protocol (drift curve recorded in
+BASELINE.md).
+
+Abs-sum (the CUDA variant's reduction), not the plain sum of the CPU
+macro: sign cancellations can hide real drift.  The traces are
+unconditional once enabled — the env var IS the -vvv-style knob, so
+parity scripts don't have to thread verbosity through.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from hpnn_tpu.utils import logging as log
+
+
+def enabled() -> bool:
+    return os.environ.get("HPNN_TRACE", "") not in ("", "0")
+
+
+def trace(tag: str, arrays) -> None:
+    """Emit one ``#DBG`` line per array in ``arrays`` (device arrays
+    are fetched — only pay that when the knob is on)."""
+    if not enabled():
+        return
+    for l, a in enumerate(arrays):
+        acc = float(np.abs(np.asarray(a)).sum())
+        log.nn_write(sys.stdout, "#DBG: acc[%s/%i]=%.15f\n", tag, l, acc)
+    log.flush()

@@ -343,8 +343,10 @@ def test_batch_stall_halves_dispatch_cap(tmp_path, capsys, monkeypatch):
 
     monkeypatch.setattr(batch_mod, "make_multi_epoch_fn", killed_make)
     monkeypatch.setattr(batch_mod, "make_multi_epoch_bank_fn", killed_make)
-    # n=24, B=8 -> n_steps=3 -> heuristic cap 65536//3 = 21845
-    expect = [21845, 10922, 5461]
+    # n=24, B=8 -> n_steps=3 -> heuristic cap 65536//3 = 21845, rounded
+    # down to whole bank-refresh groups (R=8 default); each stalled
+    # resume halves then re-rounds
+    expect = [21840, 10920, 5456]
     for want_cap in expect:
         with pytest.raises(KeyboardInterrupt):
             batch_mod.train_kernel_batched(
@@ -380,9 +382,10 @@ def test_batch_stall_halves_dispatch_cap(tmp_path, capsys, monkeypatch):
 ])
 def test_bank_matches_gather_trajectory(tmp_path, capsys, monkeypatch, snn,
                                         train):
-    """The bank data path (per-epoch device permute + sequential
-    blocks) trains on the SAME batches as the per-step gather path —
-    token streams and final kernels must match bitwise."""
+    """The bank data path at refresh=1 (fresh device permute every
+    epoch + sequential blocks) trains on the SAME batches as the
+    per-step gather path — token streams and final kernels must match
+    bitwise (the parity configuration of the r05 roofline lever)."""
     from hpnn_tpu.utils import logging as log
 
     conf = _conf(tmp_path, snn=snn, train=train)
@@ -394,11 +397,109 @@ def test_bank_matches_gather_trajectory(tmp_path, capsys, monkeypatch, snn,
     gather_out = capsys.readouterr().out
 
     monkeypatch.setenv("HPNN_BANK", "1")
+    monkeypatch.setenv("HPNN_BANK_REFRESH", "1")
     c2 = _conf_copy(conf)
     assert batch_mod.train_kernel_batched(c2, batch_size=8, epochs=6)
     bank_out = capsys.readouterr().out
 
     assert "BATCH EPOCH" in gather_out
     assert gather_out == bank_out
+    for a, b in zip(c1.kernel.weights, c2.kernel.weights):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bank_refresh_groups_match_explicit_loop():
+    """make_multi_epoch_bank_fn with refresh groups (G=2, R=2) ==
+    an explicit host loop over the same permutations/orders, for both
+    the XLA block-indexed step and (interpret-mode) the banked Pallas
+    kernel path's math twin."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.parallel import dp
+
+    rng = np.random.RandomState(4)
+    k, _ = kernel_mod.generate(9, 6, [5], 3)
+    weights = tuple(jnp.asarray(np.asarray(w), jnp.float32) for w in k.weights)
+    n, B, S, G, R = 24, 8, 3, 2, 2
+    X = jnp.asarray(rng.uniform(-1, 1, (n, 6)), jnp.float32)
+    T = np.full((n, 3), -1.0, dtype=np.float32)
+    T[np.arange(n), rng.randint(0, 3, n)] = 1.0
+    T = jnp.asarray(T)
+    perms = np.stack([np.random.RandomState(s).permutation(n)
+                      for s in range(G)]).astype(np.int32)
+    orders = np.stack([
+        np.stack([np.random.RandomState(10 + g * R + r).permutation(S)
+                  for r in range(R)]) for g in range(G)
+    ]).astype(np.int32)
+
+    def step_fn(w, m, Xb, Tb):
+        return dp.train_step_math(w, m, Xb, Tb, model="ann",
+                                  momentum=False, lr=0.05, alpha=0.2)
+
+    mf = batch_mod.make_multi_epoch_bank_fn(
+        step_fn, batch_mod.make_device_count_fn(model="ann"), S,
+        banked=False)
+    w_all, _, losses, counts = mf(weights, (), X, T,
+                                  jnp.asarray(perms), jnp.asarray(orders))
+    assert losses.shape == (G * R, S) and counts.shape == (G * R,)
+
+    w = weights
+    e = 0
+    cf = batch_mod.make_device_count_fn(model="ann")
+    for g in range(G):
+        Xp, Tp = X[perms[g]], T[perms[g]]
+        for r in range(R):
+            for kk in orders[g, r]:
+                Xb = Xp[kk * B:(kk + 1) * B]
+                Tb = Tp[kk * B:(kk + 1) * B]
+                w, _, l = step_fn(w, (), Xb, Tb)
+            assert int(cf(w, X, T)) == int(counts[e])
+            e += 1
+    for a, b in zip(w_all, w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_bank_sub_refresh_cap_resumes_exactly(tmp_path, capsys, monkeypatch):
+    """A survival cap shrunk below the refresh period R (stall
+    halving) must dispatch sub-group blocks — aligned sub-R draws a
+    fresh bank permutation, a mid-group continuation reuses the
+    group's cur_perm and never straddles the boundary — and still
+    reproduce the uninterrupted run's token stream exactly."""
+    from hpnn_tpu.parallel import dp
+    from hpnn_tpu.train.driver import _save_fuse_state
+    from hpnn_tpu.utils import logging as log
+
+    conf = _conf(tmp_path)
+    log.set_verbose(2)
+    epochs = 20
+    c1 = _conf_copy(conf)
+    assert batch_mod.train_kernel_batched(c1, batch_size=8, epochs=epochs,
+                                          mesh_spec="1x1")
+    want = capsys.readouterr().out
+
+    state = tmp_path / "b.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    c2 = _conf_copy(conf)
+    # plant a checkpoint at done=0 with a sub-R cap hint (chunk=3): the
+    # run adopts cap=3 < R=8 and must walk blocks 3/3/2 | 3/3/2 | 3/1
+    key = batch_mod._batch_state_key(
+        conf.samples, "ann", False,
+        tuple(tuple(int(d) for d in np.asarray(w).shape)
+              for w in c2.kernel.weights),
+        8, dp.default_lr("ann", False), epochs,
+        "xla-bank8/generate",
+        names=[f"s{i:05d}.txt" for i in range(24)])
+    _save_fuse_state(str(state), key, conf.seed, 0, 3,
+                     [np.asarray(w) for w in c2.kernel.weights])
+    assert batch_mod.train_kernel_batched(c2, batch_size=8, epochs=epochs,
+                                          mesh_spec="1x1")
+    got = capsys.readouterr().out
+
+    def lines(s):
+        return [ln for ln in s.splitlines() if "BATCH EPOCH" in ln]
+
+    assert len(lines(want)) == epochs
+    assert lines(got) == lines(want)
     for a, b in zip(c1.kernel.weights, c2.kernel.weights):
         assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -1,0 +1,93 @@
+"""The embedding-API flow documented in docs/api.md — the reference's
+"train an ANN on the fly" story (`/root/reference/README.md:10-34`,
+`_NN(a,b)` surface `include/libhpnn.h:58-215`): a host program
+generates a kernel, trains it over samples it produced itself, queries
+it, dumps it, and a NEXT run loads and reuses it."""
+
+import numpy as np
+
+import hpnn_tpu
+from hpnn_tpu.utils import logging as nn_log
+
+
+def _write_samples(d, n=16):
+    rng = np.random.default_rng(1)
+    for i in range(n):
+        c = i % 2
+        x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
+            + 0.1 * rng.normal(size=8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        with open(d / f"s{i:05d}.txt", "w") as fp:
+            fp.write("[input] 8\n" + " ".join(f"{v:.5f}" for v in x) + "\n")
+            fp.write("[output] 2\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+def test_embedded_train_run_dump_load(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    hpnn_tpu.runtime.init_all(0)
+    nn_log.set_verbose(2)
+
+    conf = hpnn_tpu.NNConf(
+        name="embedded", type=hpnn_tpu.NNType.ANN,
+        train=hpnn_tpu.NNTrain.BP, seed=10958,
+    )
+    assert hpnn_tpu.generate_kernel(conf, n_in=8, hiddens=[6], n_out=2)
+    assert conf.kernel.n_inputs == 8
+    assert conf.kernel.n_outputs == 2
+    assert conf.kernel.hidden_sizes == (6,)
+
+    sdir = tmp_path / "samples"
+    sdir.mkdir()
+    _write_samples(sdir)
+    conf.samples = conf.tests = str(sdir)
+
+    assert hpnn_tpu.train_kernel(conf)
+    hpnn_tpu.run_kernel(conf)
+    out = capsys.readouterr().out
+    assert out.count("TRAINING FILE:") == 16
+    assert out.count("SUCCESS!") == 16
+    assert out.count("[PASS]") == 16
+
+    with open("kernel.opt", "w") as fp:
+        hpnn_tpu.dump_kernel(conf, fp)
+
+    # "next program run": a fresh handle loads the dumped kernel and
+    # queries it in memory (the doc's run_sample snippet)
+    conf2 = hpnn_tpu.NNConf(
+        type=hpnn_tpu.NNType.ANN, f_kernel="kernel.opt",
+    )
+    assert hpnn_tpu.load_kernel(conf2)
+    import jax.numpy as jnp
+
+    from hpnn_tpu.train import loop
+
+    x, t = hpnn_tpu.read_sample(str(sdir / "s00000.txt"))
+    o = np.asarray(loop.run_sample(
+        tuple(jnp.asarray(w) for w in conf2.kernel.weights),
+        jnp.asarray(x), model="ann",
+    ))
+    assert int(np.argmax(o)) == int(np.argmax(t))
+    # the dumped text round-trips bit-for-bit through %17.15f
+    for a, b in zip(conf.kernel.weights, conf2.kernel.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=1e-15)
+
+
+def test_import_is_light(tmp_path):
+    """``import hpnn_tpu`` must not pull the training stack (host
+    programs may only manipulate confs/kernels); the execute-ops
+    resolve lazily.  (Asserting on 'jax' itself would be vacuous here:
+    this environment's sitecustomize imports jax at interpreter
+    startup.)"""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; import hpnn_tpu; "
+        "assert 'hpnn_tpu.train.driver' not in sys.modules, 'eager driver'; "
+        "assert 'hpnn_tpu.train.loop' not in sys.modules, 'eager loop'; "
+        "hpnn_tpu.train_kernel; "
+        "assert 'hpnn_tpu.train.driver' in sys.modules"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True)

@@ -1,0 +1,83 @@
+"""HPNN_TRACE — the DBG_TRACE twin (utils/trace.py).
+
+The reference's cross-backend oracle instrument (abs-sum traces,
+ref: include/libhpnn/ann.h:29-33) must emit per-sample weight traces
+and per-file output traces whose values equal the numpy abs-sums of
+the arrays the drivers actually used."""
+
+import re
+
+import numpy as np
+import pytest
+
+from hpnn_tpu.config import NNConf, NNTrain, NNType
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.train import batch as batch_mod, driver
+from hpnn_tpu.utils import logging as log
+
+
+def _conf(tmp_path, n=6):
+    rng = np.random.RandomState(0)
+    sdir = tmp_path / "samples"
+    sdir.mkdir()
+    for i in range(n):
+        c = i % 2
+        x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
+            + 0.1 * rng.normal(size=8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        with open(sdir / f"s{i:05d}.txt", "w") as fp:
+            fp.write("[input] 8\n" + " ".join(f"{v:.5f}" for v in x) + "\n")
+            fp.write("[output] 2\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return NNConf(name="t", type=NNType.ANN, seed=1, kernel=k,
+                  train=NNTrain.BP, samples=str(sdir), tests=str(sdir))
+
+
+def _parse(out):
+    return {
+        m.group(1): float(m.group(2))
+        for m in re.finditer(r"#DBG: acc\[(.+?)\]=([0-9.]+)", out)
+    }
+
+
+def test_trace_off_by_default(tmp_path, capsys):
+    conf = _conf(tmp_path)
+    log.set_verbose(2)
+    assert driver.train_kernel(conf)
+    assert "#DBG" not in capsys.readouterr().out
+
+
+def test_train_and_eval_traces(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("HPNN_TRACE", "1")
+    conf = _conf(tmp_path)
+    log.set_verbose(2)
+    assert driver.train_kernel(conf)
+    traces = _parse(capsys.readouterr().out)
+    # final-weights trace equals the numpy abs-sum of the round result
+    for l, w in enumerate(conf.kernel.weights):
+        want = float(np.abs(np.asarray(w)).sum())
+        got = traces[f"w@6/{l}"]
+        assert got == pytest.approx(want, rel=1e-12)
+
+    driver.run_kernel(conf)
+    ev = _parse(capsys.readouterr().out)
+    assert len([k for k in ev if k.startswith("out@")]) == 6
+
+    # batched eval traces the same per-file abs-sums (shared oracle)
+    batch_mod.run_kernel_batched(conf)
+    evb = _parse(capsys.readouterr().out)
+    for key, v in ev.items():
+        assert evb[key] == pytest.approx(v, rel=1e-6)
+
+
+def test_batch_trace_per_block(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("HPNN_TRACE", "1")
+    conf = _conf(tmp_path)
+    log.set_verbose(2)
+    assert batch_mod.train_kernel_batched(conf, batch_size=4, epochs=3,
+                                          mesh_spec="1x1")
+    traces = _parse(capsys.readouterr().out)
+    for l, w in enumerate(conf.kernel.weights):
+        want = float(np.abs(np.asarray(w)).sum())
+        assert traces[f"w@3/{l}"] == pytest.approx(want, rel=1e-12)

@@ -130,9 +130,12 @@ def make_variants(*, n_in, n_hidden, n_out, B, S, momentum, model="ann"):
     fns = {
         "gather-xla": batch_mod.make_multi_epoch_fn(math_step, count_fn),
         "gather-pallas": batch_mod.make_multi_epoch_fn(pallas_step, count_fn),
-        "bank-xla": batch_mod.make_multi_epoch_bank_fn(
+        # the PRODUCTION r05 path: refresh groups of R epochs (perms
+        # (G, n_rows) + orders (G, R, S)); R is encoded in the idx
+        # arrays, so the same jit serves any R
+        "bankR-xla": batch_mod.make_multi_epoch_bank_fn(
             math_step, count_fn, S, banked=False),
-        "bank-pallas": batch_mod.make_multi_epoch_bank_fn(
+        "bankR-pallas": batch_mod.make_multi_epoch_bank_fn(
             banked_step, count_fn, S, banked=True),
         "order-xla": make_order_fn(False),
         "order-pallas": make_order_fn(True),
@@ -162,17 +165,29 @@ def run_shape(label, *, n_in, n_hidden, n_out, B, S, momentum,
     n_rows = S * B
     rng = np.random.RandomState(3)
 
+    REFRESH = 8  # production default (HPNN_BANK_REFRESH)
+
     def put_idx(E, name):
-        if name.startswith("bank"):
-            arr = np.stack([rng.permutation(n_rows) for _ in range(E)])
-        elif name.startswith("gather"):
+        if name.startswith("bankR"):
+            # the slope math assumes exactly E epochs execute
+            assert E % REFRESH == 0, (
+                f"bankR variants need E % {REFRESH} == 0, got {E}")
+            g = E // REFRESH
+            perms = np.stack([rng.permutation(n_rows) for _ in range(g)])
+            orders = np.stack([
+                np.stack([rng.permutation(S) for _ in range(REFRESH)])
+                for _ in range(g)
+            ])
+            return (jax.device_put(jnp.asarray(perms.astype(np.int32))),
+                    jax.device_put(jnp.asarray(orders.astype(np.int32))))
+        if name.startswith("gather"):
             arr = np.stack([rng.permutation(n_rows).reshape(S, B)
                             for _ in range(E)])
         elif name.startswith("order"):
             arr = np.stack([rng.permutation(S) for _ in range(E)])
         else:  # seq
             arr = np.arange(E)
-        return jax.device_put(jnp.asarray(arr.astype(np.int32)))
+        return (jax.device_put(jnp.asarray(arr.astype(np.int32))),)
 
     idx = {
         name: {E: put_idx(E, name) for E in (e_small, e_big)}
@@ -181,7 +196,7 @@ def run_shape(label, *, n_in, n_hidden, n_out, B, S, momentum,
 
     def timed(fn, E, name):
         t0 = time.perf_counter()
-        w, m, losses, counts = fn(weights, dw, X, T, idx[name][E])
+        w, m, losses, counts = fn(weights, dw, X, T, *idx[name][E])
         np.asarray(counts[-1])  # host-transfer fence
         return time.perf_counter() - t0
 
@@ -229,13 +244,15 @@ def run_shape(label, *, n_in, n_hidden, n_out, B, S, momentum,
 def main():
     quick = "--quick" in sys.argv
     rep = 2 if quick else 5
+    # epoch counts are multiples of REFRESH so the bankR variants
+    # cover exactly E epochs (G·R == E)
     run_shape("mnist 784-300-10 BP", n_in=784, n_hidden=300, n_out=10,
               B=1024, S=60, momentum=False,
-              e_small=5, e_big=55 if quick else 225, repeats=rep)
+              e_small=8, e_big=56 if quick else 224, repeats=rep)
     if "--mnist-only" not in sys.argv:
         run_shape("xrd 851-230-230 BPM", n_in=851, n_hidden=230, n_out=230,
                   B=256, S=15, momentum=True,
-                  e_small=20, e_big=220 if quick else 900, repeats=rep)
+                  e_small=24, e_big=224 if quick else 896, repeats=rep)
 
 
 if __name__ == "__main__":
