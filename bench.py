@@ -139,10 +139,20 @@ def bench_per_sample():
             0.2, loop.DELTA_BP, **kw,
         )
 
-    # warm both paths
-    w, stats = loop.train_epoch_lax(
+    # the headline measures the driver's ACTUAL round dispatch
+    # (loop.train_epoch): the Mosaic-kernel scan body on TPU/f32 since
+    # r05, the lax body elsewhere; when the kernel body is active the
+    # lax body is timed too, interleaved, for a paired body comparison
+    epoch_body = "pallas" if loop._pallas_epoch_default(weights0) else "lax"
+
+    # warm all paths
+    w, stats = loop.train_epoch(
         weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
     np.asarray(stats[1][-1:])
+    if epoch_body == "pallas":
+        w, stats = loop.train_epoch_lax(
+            weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
+        np.asarray(stats[1][-1:])
     r = one(weights0, *samples[0])
     int(r.n_iter)
 
@@ -153,12 +163,20 @@ def bench_per_sample():
     # silently disagree with the median throughput if repeats varied —
     # determinism across repeats is itself worth recording).
     fused_sps, sps_runs, fused_iters, disp_iters = [], [], [], []
+    lax_sps, lax_iters = [], []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
-        w, stats = loop.train_epoch_lax(
+        w, stats = loop.train_epoch(
             weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
         fused_iters.append(int(np.asarray(stats[1]).sum()))  # fence
         fused_sps.append(N_SAMPLES / (time.perf_counter() - t0))
+
+        if epoch_body == "pallas":
+            t0 = time.perf_counter()
+            w, stats = loop.train_epoch_lax(
+                weights0, (), X, T, 0.2, loop.DELTA_BP, **kw)
+            lax_iters.append(int(np.asarray(stats[1]).sum()))
+            lax_sps.append(N_SAMPLES / (time.perf_counter() - t0))
 
         weights = weights0
         total_iters = 0
@@ -170,7 +188,8 @@ def bench_per_sample():
         sps_runs.append(N_SAMPLES / (time.perf_counter() - t0))
         disp_iters.append(total_iters)
     paired_ratio = [round(f / s, 2) for f, s in zip(fused_sps, sps_runs)]
-    return {
+    out = {
+        "epoch_body": epoch_body,
         "samples_per_s": _stats(fused_sps),
         "total_inner_iters": fused_iters[-1],
         "total_inner_iters_per_repeat": fused_iters,
@@ -184,6 +203,18 @@ def bench_per_sample():
             "median": round(statistics.median(paired_ratio), 2),
         },
     }
+    if lax_sps:
+        deltas = [round(100.0 * (p - x) / x, 1)
+                  for p, x in zip(fused_sps, lax_sps)]
+        out["epoch_lax"] = {
+            "samples_per_s": _stats(lax_sps),
+            "total_inner_iters": lax_iters[-1],
+        }
+        out["paired_pallas_epoch_vs_lax_pct"] = {
+            "per_round": deltas,
+            "median": round(statistics.median(deltas), 1),
+        }
+    return out
 
 
 def bench_batch():
@@ -568,6 +599,11 @@ def main(argv=None) -> None:
             out["per_sample"]["per_sample_dispatch"]["samples_per_s"]["median"]
         )
         compact["fused_total_inner_iters"] = out["per_sample"]["total_inner_iters"]
+        compact["epoch_body"] = out["per_sample"]["epoch_body"]
+        if "paired_pallas_epoch_vs_lax_pct" in out["per_sample"]:
+            compact["paired_pallas_epoch_vs_lax_pct"] = (
+                out["per_sample"]["paired_pallas_epoch_vs_lax_pct"]["median"]
+            )
     if "batch" in out:
         b = out["batch"]
         compact["batch_sps_median"] = b["samples_per_s"]["median"]

@@ -20,17 +20,23 @@ before the min-iter clamp, first_ok at it==1, ok & it>min_iter after
 the loop); tests/test_pallas.py proves equality iteration-for-iteration
 against the lax implementation in interpret mode.
 
-Supported: ANN and SNN, BP and BPM (momentum), any depth.  Opt in with
-``HPNN_PALLAS=1``.
+Supported: ANN and SNN, BP and BPM (momentum), any depth.
 
-Measured reality check (v5e, MNIST 784-300-10, BASELINE.md): XLA's
-while_loop path reaches 22.0k iters/s where this kernel reaches 14.9k
-at faithful (HIGHEST) dot precision — at M=1 matvec shapes XLA's fused
-VPU reductions beat Mosaic's MXU lowering, and with default (bf16-
-input) dots the kernel is fast but its trajectories diverge from the
-f64 oracle (26.2k vs 41.9k total iterations on the probe workload).
-All dots therefore pin ``precision=HIGHEST``; the lax path stays the
-default dispatch.
+Measured reality check, revised in r05 (v5e, BASELINE.md "per-sample
+kernel sweep"): the r04 "XLA 22.0k vs kernel 14.9k iters/s" comparison
+was dispatch-floor-contaminated (short convergence runs, ~100 ms
+tunnel round trip per sample).  With the dispatch amortized (≥16k-iter
+budgets) the kernel WINS at faithful (HIGHEST) dot precision at every
+shape tried: +10% (MNIST 784-300-10), +6% (XRD 851-230-230), +41%
+(16-[32]×8-4), +31% (8-[16]×12-3), +13% (256-64-8).  Since r05 the
+fused-EPOCH scan (:func:`train_epoch_fused`, the driver's round
+dispatch) therefore uses this kernel by default on TPU/f32;
+``HPNN_PALLAS=0`` forces the lax body back, ``=1`` selects the
+streaming one-dispatch-per-sample study path.  With default
+(bf16-input) dots the kernel would be faster still but its
+trajectories diverge from the f64 oracle (26.2k vs 41.9k total
+iterations on the probe workload) — all dots pin
+``precision=HIGHEST``.
 """
 
 from __future__ import annotations
@@ -282,6 +288,46 @@ def train_sample_fused(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "momentum", "min_iter", "max_iter", "interpret"),
+)
+def train_epoch_fused(
+    weights,
+    dw0,
+    X,
+    T,
+    alpha,
+    delta,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int,
+    max_iter: int,
+    interpret: bool = False,
+):
+    """``loop.train_epoch_lax`` with the fused Mosaic kernel as the
+    per-sample body: one dispatch per chunk (the scan), one kernel
+    launch per sample inside it.  Same signature/stats contract as the
+    lax epoch; momentum raz quirk preserved (every sample starts from
+    ``dw0``).  The r05 default body for the driver's fused rounds on
+    TPU/f32 (see module docstring for the paired sweep)."""
+
+    def body(w, xt):
+        x, t = xt
+        res = train_sample_fused(
+            w, dw0, x, t, alpha, delta,
+            model=model, momentum=momentum,
+            min_iter=min_iter, max_iter=max_iter, interpret=interpret,
+        )
+        return res.weights, (
+            res.ep0, res.n_iter, res.dep, res.first_ok, res.final_ok
+        )
+
+    weights, stats = lax.scan(body, weights, (X, T))
+    return weights, stats
+
+
 # ---------------------------------------------------------------------------
 # Batched (M-dimension) fused minibatch step: the MXU-shaped variant.
 #
@@ -311,6 +357,7 @@ def _batch_step_kernel(
     lr: float,
     alpha: float,
     inv_b: float,
+    loss_at_program_id: bool = False,
 ):
     # ref layout: [aliased input state refs (ignored), output state
     # refs, loss ref, then scratch: acts and deltas per layer]
@@ -375,15 +422,17 @@ def _batch_step_kernel(
             dw[l][:] = alpha * m
         else:
             w[l][:] = w[l][:] + (lr * inv_b) * outer
-    # post-update loss, like train_step_math's re-forward
+    # post-update loss, like train_step_math's re-forward; the grid
+    # epoch kernel writes each step's slot of the (S,) SMEM output
+    slot = pl.program_id(0) if loss_at_program_id else 0
     forward()
     if model == "snn":
         o = acts[-1][:]
         n_out = o.shape[1]
-        loss_ref[0] = -jnp.sum(t * jnp.log(o + snn.TINY)) * inv_b / n_out
+        loss_ref[slot] = -jnp.sum(t * jnp.log(o + snn.TINY)) * inv_b / n_out
     else:
         d = t - acts[-1][:]
-        loss_ref[0] = 0.5 * jnp.sum(d * d) * inv_b
+        loss_ref[slot] = 0.5 * jnp.sum(d * d) * inv_b
 
 
 @functools.partial(
@@ -551,6 +600,109 @@ def train_step_fused_banked(
     new_w = tuple(results[:n_layers])
     new_dw = tuple(results[n_layers : 2 * n_layers]) if momentum else ()
     return new_w, new_dw, results[n_state][0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("batch", "model", "momentum", "lr", "alpha",
+                              "interpret")
+)
+def train_epoch_grid_banked(
+    weights,
+    dw,
+    X_bank,
+    T_bank,
+    order,
+    *,
+    batch: int,
+    model: str = "ann",
+    momentum: bool = False,
+    lr: float | None = None,
+    alpha: float = 0.2,
+    interpret: bool = False,
+):
+    """A WHOLE epoch of banked minibatch steps as ONE Mosaic launch:
+    ``grid=(S,)`` with the step's block id scalar-prefetched from
+    ``order`` — Pallas pipelines the next step's (B, n) block fetch
+    behind the current step's compute (the DMA overlap the
+    scan-of-kernels path cannot get across launches), and the weights
+    (constant ``index_map``) stay VMEM-resident across all S steps,
+    written back once.  Paired slope on v5e (BASELINE.md r05): ~+28%
+    median over the banked-kernel scan at the production MNIST shape
+    (B=256, 60k bank).
+
+    The r04 note "a grid-resident epoch kernel measured slower" was
+    the pre-bank design measured on the small-bank harness; this one
+    replaces it.  Semantics are exactly S successive
+    :func:`train_step_fused_banked` steps (same math, same VMEM
+    budget; parity-tested in interpret mode).
+
+    order: (S,) int32 block ids.  Returns (weights, dw, losses[S]).
+    """
+    n_layers = len(weights)
+    if lr is None:
+        from hpnn_tpu.parallel import dp
+
+        lr = dp.default_lr(model, momentum)
+    weights = tuple(jnp.asarray(wl, dtype=_F32) for wl in weights)
+    dw = tuple(jnp.asarray(m, dtype=_F32) for m in dw) if momentum else ()
+    X_bank = jnp.asarray(X_bank, dtype=_F32)
+    T_bank = jnp.asarray(T_bank, dtype=_F32)
+    B = int(batch)
+    S = int(order.shape[0])
+    n_in = X_bank.shape[1]
+    n_out = T_bank.shape[1]
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    n_state = n_layers * (2 if momentum else 1)
+    state = tuple(weights) + tuple(dw)
+
+    def _const_spec(arr):
+        nd = len(arr.shape)
+        return pl.BlockSpec(arr.shape, lambda i, o, _n=nd: (0,) * _n)
+
+    out_shape = (
+        tuple(jax.ShapeDtypeStruct(wl.shape, _F32) for wl in weights)
+        + (tuple(jax.ShapeDtypeStruct(m.shape, _F32) for m in dw)
+           if momentum else ())
+        + (jax.ShapeDtypeStruct((S,), _F32),)  # per-step losses
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((B, n_in), lambda i, o: (o[i], 0)),
+            pl.BlockSpec((B, n_out), lambda i, o: (o[i], 0)),
+        ] + [_const_spec(s) for s in state],
+        out_specs=tuple(_const_spec(s) for s in state) + (smem,),
+        scratch_shapes=[
+            pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights
+        ] + [pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights],
+    )
+    aliases = {3 + i: i for i in range(n_state)}
+
+    def kernel(ord_ref, *refs):  # order consumed by the index_map only
+        del ord_ref
+        _batch_step_kernel(
+            *refs,
+            n_layers=n_layers,
+            model=model,
+            momentum=momentum,
+            lr=float(lr),
+            alpha=float(alpha),
+            inv_b=1.0 / B,
+            loss_at_program_id=True,
+        )
+
+    results = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid_spec=grid_spec,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(jnp.asarray(order, dtype=jnp.int32), X_bank, T_bank, *state)
+    new_w = tuple(results[:n_layers])
+    new_dw = tuple(results[n_layers : 2 * n_layers]) if momentum else ()
+    return new_w, new_dw, results[n_state]
 
 
 def make_pallas_epoch_fn(weights, *, model: str = "ann",

@@ -208,10 +208,14 @@ def make_multi_epoch_bank_fn(step_fn, count_fn, n_steps: int, *,
     bandwidth (the SGD schedule changes; acceptance bar is final
     accuracy, like everything in batch mode).
 
-    ``banked=True``: step_fn(w, m, Xp, Tp, k) is the Pallas kernel
-    reading block ``k`` straight from the HBM bank via a scalar-
-    prefetched index_map (pallas_train.train_step_fused_banked) —
-    zero per-step copy.  ``banked=False``: the XLA step on the
+    ``banked="grid"``: step_fn(w, m, Xp, Tp, ord_e) runs the WHOLE
+    epoch as one Mosaic launch (pallas_train.train_epoch_grid_banked
+    — block fetches pipelined behind compute, weights VMEM-resident
+    across steps; +28% paired over the per-step-launch variant).
+    ``banked=True``: step_fn(w, m, Xp, Tp, k) is the per-step Pallas
+    kernel reading block ``k`` straight from the HBM bank via a
+    scalar-prefetched index_map (pallas_train.train_step_fused_banked)
+    — zero per-step copy.  ``banked=False``: the XLA step on the
     block-indexed slice of the reshaped ``(S, B, n)`` bank.
     """
     import jax
@@ -229,6 +233,9 @@ def make_multi_epoch_bank_fn(step_fn, count_fn, n_steps: int, *,
 
             def epoch(c, ord_e):
                 w2, m2 = c
+                if banked == "grid":
+                    w2, m2, losses = step_fn(w2, m2, Xp, Tp, ord_e)
+                    return (w2, m2), (losses, count_fn(w2, X, T))
 
                 def body(cc, k):
                     w3, m3 = cc
@@ -437,9 +444,11 @@ def train_kernel_batched(
                 from hpnn_tpu.ops import pallas_train
 
                 if use_bank:
-                    def step_fn(w, m, Xp, Tp, k):
-                        return pallas_train.train_step_fused_banked(
-                            w, m, Xp, Tp, k, batch=B, model=model,
+                    # the grid-epoch kernel: one Mosaic launch per
+                    # epoch (+28% paired over per-step launches, r05)
+                    def step_fn(w, m, Xp, Tp, ord_e):
+                        return pallas_train.train_epoch_grid_banked(
+                            w, m, Xp, Tp, ord_e, batch=B, model=model,
                             momentum=momentum, lr=lr, alpha=0.2,
                         )
                 else:
@@ -453,7 +462,7 @@ def train_kernel_batched(
             if use_bank:
                 return make_multi_epoch_bank_fn(
                     step_fn, count_fn, n_steps,
-                    banked=with_pallas,
+                    banked="grid" if with_pallas else False,
                 )
             return make_multi_epoch_fn(step_fn, count_fn)
 

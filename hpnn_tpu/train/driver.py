@@ -137,8 +137,19 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             )
 
         def train_epoch(w, m0, Xc, Tc):
-            # looked up through the module so tests can monkeypatch
-            # loop.train_epoch_lax (crash simulation)
+            # the fused-round scan body: the Mosaic kernel on TPU/f32
+            # since r05, the lax body elsewhere (use_pallas_epoch below
+            # — mutable: the Mosaic-failure handler flips it).  Looked
+            # up through the module so tests can monkeypatch
+            # loop.train_epoch_lax (the body CPU tests hit).
+            if use_pallas_epoch:
+                from hpnn_tpu.ops import pallas_train
+
+                return pallas_train.train_epoch_fused(
+                    w, m0, jnp.asarray(Xc), jnp.asarray(Tc), alpha, delta,
+                    model=model, momentum=momentum,
+                    min_iter=min_iter, max_iter=max_iter,
+                )
             return loop.train_epoch_lax(
                 w, m0, jnp.asarray(Xc), jnp.asarray(Tc), alpha, delta,
                 model=model, momentum=momentum,
@@ -168,18 +179,38 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         # crash-resume is a single-process feature (same guard as
         # batch.py)
         state_path = None
+    # epoch body for the fused rounds: bound BEFORE the checkpoint key
+    # is computed — the two bodies are not bit-identical on hardware
+    # (reduction order, see loop.train_epoch), so a resume must
+    # continue on the body that wrote the checkpoint (same discipline
+    # as batch._make_state_key)
+    use_pallas_epoch = tp_state is None and loop._pallas_epoch_default(weights)
+
+    def _make_key(pallas_body):
+        # key over the TRAINING weight shapes (padded for TP), so a
+        # checkpoint from a different mesh layout is never adopted;
+        # the epoch body is tagged for the same reason
+        return _fuse_state_key(
+            conf.samples, model, momentum,
+            tuple(tuple(int(d) for d in w.shape) for w in weights),
+            ("pallas-epoch/" if pallas_body else "lax/")
+            + _init_identity(conf, weights_np),
+            names=census,
+        )
+
     state_key = None
     state = None
     if state_path:
-        # key over the TRAINING weight shapes (padded for TP), so a
-        # checkpoint from a different mesh layout is never adopted
-        state_key = _fuse_state_key(
-            conf.samples, model, momentum,
-            tuple(tuple(int(d) for d in w.shape) for w in weights),
-            _init_identity(conf, weights_np),
-            names=census,
-        )
+        state_key = _make_key(use_pallas_epoch)
         state = _load_fuse_state(state_path, state_key)
+        if state is None and use_pallas_epoch:
+            # a crashed predecessor may have fallen back to the lax
+            # body mid-round and re-keyed: adopt its checkpoint AND
+            # stay on that body (seed-checked below like any state)
+            alt_key = _make_key(False)
+            alt = _load_fuse_state(state_path, alt_key)
+            if alt is not None and conf.seed in (0, int(alt["seed"])):
+                state_key, state, use_pallas_epoch = alt_key, alt, False
         if state is not None and conf.seed not in (0, int(state["seed"])):
             state = None  # different seeded round requested: start over
     if state is not None:
@@ -286,13 +317,34 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             try:
                 weights, stats = train_epoch(weights, dw0, Xc, Tc)
                 stats = tuple(np.asarray(s) for s in stats)
-            except jax.errors.JaxRuntimeError:
+            except Exception as exc:
+                if use_pallas_epoch and "UNAVAILABLE" not in str(exc):
+                    # Mosaic refused the fused-epoch kernel (the
+                    # _pallas_hw_ok heuristic is not a compiler): fall
+                    # back to the lax body, re-key the checkpoint to
+                    # the body actually running from here on, and
+                    # retry the same chunk — same discipline as
+                    # batch.py's fused-kernel fallback.  UNAVAILABLE =
+                    # worker crash, not a compile problem.
+                    log.nn_warn(
+                        sys.stderr,
+                        "fused epoch kernel failed (%s); "
+                        "falling back to the lax body\n",
+                        type(exc).__name__,
+                    )
+                    use_pallas_epoch = False
+                    if state_path:
+                        state_key = _make_key(False)
+                        _save_fuse_state(
+                            state_path, state_key, conf.seed, done,
+                            chunk, host_w)
+                    continue
                 # worker killed mid-dispatch (likely the execution
                 # budget): leave a checkpoint telling the NEXT attempt
                 # to retry this chunk at half the size, then re-raise —
                 # the in-process runtime (and its device arrays) is
                 # unusable after the crash, hence the host copy
-                if state_path:
+                if isinstance(exc, jax.errors.JaxRuntimeError) and state_path:
                     # halve for the next attempt, but never above the
                     # configured size and not below a 32-sample floor
                     # (or the configured size, whichever is smaller)

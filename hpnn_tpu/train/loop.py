@@ -117,24 +117,86 @@ def convergence_loop(
 
 
 def _pallas_eligible(weights) -> bool:
-    """Fused Pallas path: opt-in (HPNN_PALLAS=1), TPU platform, f32.
-
-    Measured on v5e (BASELINE.md): for the MLP matvec shapes XLA's
-    fused while_loop is faster than the fused Mosaic kernel (22.0k vs
-    14.9k faithful-precision iters/s on MNIST 784-300-10), so the lax
-    path stays the default; the kernel remains available for
-    experimentation and as the base for batched variants.
+    """STREAMING per-sample Pallas path: opt-in (HPNN_PALLAS=1), TPU
+    platform, f32 — one host dispatch per sample, so it loses the
+    fused round's dispatch amortization; kept as the study/debug path.
+    The production use of the kernel is :func:`train_epoch` below.
     """
     import os
 
     if os.environ.get("HPNN_PALLAS", "0") != "1":
         return False
+    return _pallas_hw_ok(weights)
+
+
+def _pallas_hw_ok(weights) -> bool:
+    import numpy as np
+
     try:
         if jax.devices()[0].platform != "tpu":
             return False
     except RuntimeError:
         return False
-    return all(jnp.asarray(w).dtype == jnp.float32 for w in weights)
+    if not all(jnp.asarray(w).dtype == jnp.float32 for w in weights):
+        return False
+    # VMEM bound: weights (+momentum twin) + per-sample vectors
+    n_w = sum(int(np.prod(w.shape)) for w in weights)
+    return 4 * 2 * n_w + 16 * sum(int(w.shape[0]) for w in weights) \
+        <= 12 * 2**20
+
+
+def _pallas_epoch_default(weights) -> bool:
+    """r05 default dispatch for the fused-round body: the Mosaic
+    per-sample kernel on TPU/f32 (paired sweep, BASELINE.md: +6–41%
+    faithful-precision device rate across shapes once the dispatch
+    floor is amortized — the r04 'XLA wins at M=1' claim was
+    dispatch-contaminated).  HPNN_PALLAS=0 forces the lax body;
+    HPNN_PALLAS=1 selects the streaming study path instead (which
+    bypasses round fusion entirely, see driver.train_kernel)."""
+    import os
+
+    if os.environ.get("HPNN_PALLAS", "") == "0":
+        return False
+    return _pallas_hw_ok(weights)
+
+
+def train_epoch(
+    weights,
+    dw0,
+    X,
+    T,
+    alpha,
+    delta,
+    *,
+    model: str = "ann",
+    momentum: bool = False,
+    min_iter: int = MIN_BP_ITER,
+    max_iter: int = MAX_BP_ITER,
+):
+    """The driver's fused-round body: scan-over-samples with the
+    per-sample convergence loop inside, dispatched to the Mosaic
+    kernel body on TPU/f32 (:func:`_pallas_epoch_default`) and the
+    lax body elsewhere.  NOTE for trajectory bookkeeping: the two
+    bodies are iteration-for-iteration equal in interpret mode
+    (tests/test_pallas.py) but NOT bit-identical on hardware — Mosaic
+    and XLA reduce the error/softmax sums in different orders (each a
+    ≤1-ulp-valid f32 sum, see BASELINE.md "SNN kernel divergence"), so
+    N_ITER tokens can differ near convergence thresholds within the
+    same band as the recorded f32-vs-f64 drift.  HPNN_PALLAS=0
+    reproduces the r01–r04 XLA streams exactly."""
+    if _pallas_epoch_default(weights):
+        from hpnn_tpu.ops import pallas_train
+
+        return pallas_train.train_epoch_fused(
+            weights, dw0, X, T, alpha, delta,
+            model=model, momentum=momentum,
+            min_iter=min_iter, max_iter=max_iter,
+        )
+    return train_epoch_lax(
+        weights, dw0, X, T, alpha, delta,
+        model=model, momentum=momentum,
+        min_iter=min_iter, max_iter=max_iter,
+    )
 
 
 def train_sample(

@@ -161,7 +161,9 @@ def test_fused_round_stall_halves_chunk(workdir, capsys, monkeypatch):
     stored hint (advisor r3)."""
     from hpnn_tpu import config
     from hpnn_tpu.train import driver, loop
+    from hpnn_tpu.utils import logging as log
 
+    log.set_verbose(2)
     conf_path = _conf(workdir)
     state = workdir / "round.state"
     monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
@@ -509,3 +511,98 @@ def test_profile_trace_writes_xplane(workdir, capsys):
     assert dumped, "profiler trace directory is empty"
     assert any("xplane" in p.name or p.suffix in (".pb", ".json.gz")
                for p in dumped), [p.name for p in dumped]
+
+
+def test_fused_round_pallas_body_fallback_and_rekey(workdir, capsys,
+                                                    monkeypatch):
+    """A Mosaic refusal of the fused-epoch kernel must fall back to the
+    lax body mid-round (not burn retries on a deterministic compile
+    failure), re-key the checkpoint to the body actually running, and
+    complete with the lax round's exact token stream."""
+    from hpnn_tpu import config
+    from hpnn_tpu.ops import pallas_train
+    from hpnn_tpu.train import driver, loop
+    from hpnn_tpu.utils import logging as log
+
+    log.set_verbose(2)
+    conf_path = _conf(workdir)
+    # baseline: plain lax fused round
+    conf0 = config.load_conf(conf_path)
+    assert driver.train_kernel(conf0)
+    want = capsys.readouterr().out
+
+    state = workdir / "round.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    monkeypatch.setattr(loop, "_pallas_epoch_default", lambda w: True)
+
+    def mosaic_refuses(*a, **kw):
+        raise ValueError("Mosaic lowering failed (simulated)")
+
+    monkeypatch.setattr(pallas_train, "train_epoch_fused", mosaic_refuses)
+    conf = config.load_conf(conf_path)
+    assert driver.train_kernel(conf) is True
+    captured = capsys.readouterr()
+    assert "falling back to the lax body" in captured.err
+
+    def training_lines(s):
+        return [ln for ln in s.splitlines() if "TRAINING FILE" in ln]
+
+    assert training_lines(captured.out) == training_lines(want)
+    for a, b in zip(conf.kernel.weights, conf0.kernel.weights):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+    assert not state.exists()  # completed round cleans up
+
+
+def test_fused_round_body_binds_checkpoint_key(workdir, capsys, monkeypatch):
+    """A checkpoint written under one epoch body must not be adopted by
+    a round running the other body (the two are not bit-identical on
+    hardware) — EXCEPT the lax-keyed checkpoint of a fallen-back run,
+    which a pallas-default resume adopts AND continues on lax."""
+    from hpnn_tpu import config
+    from hpnn_tpu.train import driver, loop
+    from hpnn_tpu.utils import logging as log
+
+    log.set_verbose(2)
+    conf_path = _conf(workdir)
+    state = workdir / "round.state"
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "8")
+    # crash a lax round mid-way to leave a lax-keyed checkpoint
+    import jax
+
+    real_epoch = loop.train_epoch_lax
+    calls = {"n": 0}
+
+    def dying_epoch(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise jax.errors.JaxRuntimeError(
+                "UNAVAILABLE: TPU worker process crashed (simulated)")
+        return real_epoch(*a, **kw)
+
+    monkeypatch.setattr(loop, "train_epoch_lax", dying_epoch)
+    conf = config.load_conf(conf_path)
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        driver.train_kernel(conf)
+    capsys.readouterr()
+    assert state.exists()
+    done_before = int(np.load(state, allow_pickle=False)["done"])
+    assert done_before == 8  # one chunk survived
+
+    # resume with the pallas body as the default: the alt-key probe
+    # must adopt the lax checkpoint and stay on lax (train_epoch_fused
+    # must never be called)
+    monkeypatch.setattr(loop, "train_epoch_lax", real_epoch)
+    monkeypatch.setattr(loop, "_pallas_epoch_default", lambda w: True)
+    from hpnn_tpu.ops import pallas_train
+
+    def must_not_run(*a, **kw):
+        raise AssertionError("resume must stay on the lax body")
+
+    monkeypatch.setattr(pallas_train, "train_epoch_fused", must_not_run)
+    conf2 = config.load_conf(conf_path)
+    assert driver.train_kernel(conf2) is True
+    out = capsys.readouterr().out
+    # only the remaining samples were trained by the resume
+    assert len([ln for ln in out.splitlines() if "TRAINING FILE" in ln]) == 12
+    assert not state.exists()

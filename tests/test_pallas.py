@@ -163,3 +163,94 @@ def test_banked_step_matches_direct(model, momentum):
         assert np.array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(m1, m2):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("model,momentum", [
+    ("ann", False), ("ann", True), ("snn", False), ("snn", True),
+])
+def test_epoch_fused_matches_epoch_lax(model, momentum):
+    """Scan-over-kernel fused epoch (the r05 TPU round body) ==
+    train_epoch_lax stats/weights in interpret mode, including the
+    momentum raz (fresh dw0 per sample)."""
+    from hpnn_tpu.train import loop
+
+    weights, _, _ = _setup(3, 10, [8], 4)
+    dw0 = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    rng = np.random.RandomState(9)
+    n = 5
+    X = jnp.asarray(rng.uniform(-1, 1, (n, 10)), dtype=jnp.float32)
+    T = np.full((n, 4), -1.0, dtype=np.float32)
+    T[np.arange(n), rng.randint(0, 4, n)] = 1.0
+    T = jnp.asarray(T)
+    kw = dict(model=model, momentum=momentum, min_iter=3, max_iter=40)
+
+    w_l, st_l = loop.train_epoch_lax(
+        weights, dw0, X, T, 0.2, 1e-6, **kw)
+    w_p, st_p = pallas_train.train_epoch_fused(
+        weights, dw0, X, T, 0.2, 1e-6, interpret=True, **kw)
+    assert [int(v) for v in st_p[1]] == [int(v) for v in st_l[1]]  # n_iter
+    for a, b in zip(st_p, st_l):
+        np.testing.assert_allclose(np.asarray(a, dtype=np.float64),
+                                   np.asarray(b, dtype=np.float64),
+                                   rtol=1e-5, atol=1e-7)
+    for a, b in zip(w_p, w_l):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_train_epoch_dispatch_gates(monkeypatch):
+    """loop.train_epoch picks the kernel body only on TPU/f32 and
+    HPNN_PALLAS!=0; on this CPU suite it must route to the lax body."""
+    from hpnn_tpu.train import loop
+
+    weights, _, _ = _setup(3, 6, [5], 3)
+    assert not loop._pallas_epoch_default(weights)  # CPU platform
+    called = {}
+    real = loop.train_epoch_lax
+
+    def spy(*a, **kw):
+        called["lax"] = True
+        return real(*a, **kw)
+
+    monkeypatch.setattr(loop, "train_epoch_lax", spy)
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.uniform(-1, 1, (2, 6)), dtype=jnp.float32)
+    T = jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1]] * 2 - 1)
+    loop.train_epoch(weights, (), X, T, 0.2, 1e-6,
+                     model="ann", momentum=False, min_iter=1, max_iter=5)
+    assert called.get("lax")
+
+
+@pytest.mark.parametrize("model,momentum", [
+    ("ann", False), ("ann", True), ("snn", False), ("snn", True),
+])
+def test_grid_epoch_matches_banked_steps(model, momentum):
+    """One grid-epoch Mosaic launch == S successive banked steps in a
+    shuffled block order (the r05 production batch dispatch), bitwise
+    in interpret mode."""
+    weights, _, _ = _setup(31, 12, [10], 4)
+    dw = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+    rng = np.random.RandomState(2)
+    B, S = 8, 5
+    X = jnp.asarray(rng.uniform(-1, 1, (S * B, 12)), dtype=jnp.float32)
+    T = np.full((S * B, 4), -1.0, dtype=np.float32)
+    T[np.arange(S * B), rng.randint(0, 4, S * B)] = 1.0
+    T = jnp.asarray(T)
+    order = jnp.asarray(rng.permutation(S).astype(np.int32))
+
+    w1, m1 = weights, dw
+    losses_ref = []
+    for k in np.asarray(order):
+        w1, m1, l = pallas_train.train_step_fused_banked(
+            w1, m1, X, T, jnp.int32(k), batch=B,
+            model=model, momentum=momentum, lr=0.05, interpret=True,
+        )
+        losses_ref.append(float(l))
+    w2, m2, losses = pallas_train.train_epoch_grid_banked(
+        weights, dw, X, T, order, batch=B,
+        model=model, momentum=momentum, lr=0.05, interpret=True,
+    )
+    assert [float(v) for v in losses] == losses_ref
+    for a, b in zip(w2, w1):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(m2, m1):
+        assert np.array_equal(np.asarray(a), np.asarray(b))

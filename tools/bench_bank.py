@@ -65,6 +65,11 @@ def make_variants(*, n_in, n_hidden, n_out, B, S, momentum, model="ann"):
             w, m, Xp, Tp, kk, batch=B, model=model, momentum=momentum,
             lr=lr, alpha=0.2)
 
+    def grid_epoch(w, m, Xp, Tp, ord_e):
+        return pallas_train.train_epoch_grid_banked(
+            w, m, Xp, Tp, ord_e, batch=B, model=model, momentum=momentum,
+            lr=lr, alpha=0.2)
+
     count_fn = batch_mod.make_device_count_fn(model=model)
 
     def make_order_fn(banked):
@@ -132,10 +137,15 @@ def make_variants(*, n_in, n_hidden, n_out, B, S, momentum, model="ann"):
         "gather-pallas": batch_mod.make_multi_epoch_fn(pallas_step, count_fn),
         # the PRODUCTION r05 path: refresh groups of R epochs (perms
         # (G, n_rows) + orders (G, R, S)); R is encoded in the idx
-        # arrays, so the same jit serves any R
+        # arrays, so the same jit serves any R.  bankR-pallas is the
+        # grid-epoch kernel (the production ANN dispatch);
+        # bankRscan-pallas keeps the per-step-launch variant it
+        # replaced for comparison
         "bankR-xla": batch_mod.make_multi_epoch_bank_fn(
             math_step, count_fn, S, banked=False),
         "bankR-pallas": batch_mod.make_multi_epoch_bank_fn(
+            grid_epoch, count_fn, S, banked="grid"),
+        "bankRscan-pallas": batch_mod.make_multi_epoch_bank_fn(
             banked_step, count_fn, S, banked=True),
         "order-xla": make_order_fn(False),
         "order-pallas": make_order_fn(True),
