@@ -136,17 +136,30 @@ def make_device_count_fn(*, model: str):
     """On-device twin of eval + :func:`accuracy_counts` (same quirks,
     same HIGHEST-precision forward): count_fn(weights, X, T) -> int32
     scalar of correct samples.  Lets whole multi-epoch training runs
-    stay on device — only per-epoch (loss, count) scalars come back."""
+    stay on device — only per-epoch (loss, count) scalars come back.
+
+    ``HPNN_FAST_COUNT=1`` drops the HIGHEST pin on THIS in-training
+    progress count only (default-precision MXU matmuls run ~6× the
+    pinned rate — the per-epoch eval is the largest remaining
+    non-step cost in the r05 floor accounting, BASELINE.md): the
+    printed per-epoch acc can then differ by a few near-tie counts
+    from the pinned eval.  ``run_nn``'s eval (make_eval_fn) keeps the
+    pin unconditionally — only the progress metric is relaxed."""
     import jax
     import jax.numpy as jnp
 
     from hpnn_tpu.models import ann, snn
 
     mod = snn if model == "snn" else ann
+    fast = os.environ.get("HPNN_FAST_COUNT", "") == "1"
 
     def count(weights, X, T):
-        with jax.default_matmul_precision("float32"):
-            out = jax.vmap(lambda x: mod.run(weights, x))(X)
+        fwd = jax.vmap(lambda x: mod.run(weights, x))
+        if fast:
+            out = fwd(X)
+        else:
+            with jax.default_matmul_precision("float32"):
+                out = fwd(X)
         return _count_correct(jnp, out, T, model).astype(jnp.int32)
 
     return count

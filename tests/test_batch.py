@@ -503,3 +503,33 @@ def test_bank_sub_refresh_cap_resumes_exactly(tmp_path, capsys, monkeypatch):
     assert lines(got) == lines(want)
     for a, b in zip(c1.kernel.weights, c2.kernel.weights):
         assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fast_count_env_gates_precision(monkeypatch):
+    """HPNN_FAST_COUNT=1 relaxes only the in-training progress count;
+    on well-separated data the counts agree with the pinned counter
+    (the knob may wobble near-tie counts only)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    k, _ = kernel_mod.generate(5, 6, [5], 4)
+    weights = tuple(jnp.asarray(np.asarray(w), jnp.float32) for w in k.weights)
+    X = rng.uniform(-2, 2, (32, 6)).astype(np.float32)
+    T = np.full((32, 4), -1.0, dtype=np.float32)
+    T[np.arange(32), rng.randint(0, 4, 32)] = 1.0
+
+    pinned = batch_mod.make_device_count_fn(model="ann")
+    monkeypatch.setenv("HPNN_FAST_COUNT", "1")
+    fast = batch_mod.make_device_count_fn(model="ann")
+    a = int(pinned(weights, jnp.asarray(X), jnp.asarray(T)))
+    b = int(fast(weights, jnp.asarray(X), jnp.asarray(T)))
+    # CPU lowers both precisions identically — the knob must at least
+    # produce the same verdicts there (the relaxation is TPU-observable)
+    assert a == b
+    # the gate itself is visible in the traced computation: the pinned
+    # counter's dots carry HIGHEST precision, the fast one's must not
+    import jax
+
+    args = (weights, jnp.asarray(X), jnp.asarray(T))
+    assert "HIGHEST" in str(jax.make_jaxpr(pinned)(*args))
+    assert "HIGHEST" not in str(jax.make_jaxpr(fast)(*args))
