@@ -31,6 +31,7 @@ from hpnn_tpu.fileio import samples as sample_io
 from hpnn_tpu.models import kernel as kernel_mod
 from hpnn_tpu.parallel import dp, mesh as mesh_mod
 from hpnn_tpu.utils import logging as log
+from hpnn_tpu.utils import trace as trace_mod
 
 
 def _compute_dtype():
@@ -773,8 +774,6 @@ def train_kernel_batched(
                 epoch += 1
                 loss = float(losses[e].mean())
                 print_epoch(epoch, loss, int(counts[e]))
-            from hpnn_tpu.utils import trace as trace_mod
-
             # per-BLOCK weight trace (the multi-epoch scan returns only
             # the final weights; per-epoch snapshots would defeat the
             # fused dispatch).  enabled() gate BEFORE the host_fetch —
@@ -794,8 +793,6 @@ def train_kernel_batched(
             out = np.asarray(eval_fn(w_sh, X_eval))
             okc = accuracy_counts(out, T, model)
             print_epoch(epoch, loss, okc)
-            from hpnn_tpu.utils import trace as trace_mod
-
             if trace_mod.enabled():
                 trace_mod.trace(f"w@{epoch}", [dp.host_fetch(w, mesh)
                                                for w in w_sh])
@@ -862,8 +859,6 @@ def run_kernel_batched(conf: NNConf) -> None:
 
     from hpnn_tpu.train.driver import print_verdict
     from hpnn_tpu.utils.glibc_random import shuffled_order
-
-    from hpnn_tpu.utils import trace as trace_mod
 
     _resolve_seed(conf)
     row_of = {name: i for i, name in enumerate(names)}

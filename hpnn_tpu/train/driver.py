@@ -34,6 +34,7 @@ from hpnn_tpu.fileio import samples as sample_io
 from hpnn_tpu.models import kernel as kernel_mod
 from hpnn_tpu.train import loop
 from hpnn_tpu.utils import logging as log
+from hpnn_tpu.utils import trace as trace_mod
 
 
 def _compute_dtype():
@@ -354,8 +355,6 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                     )
                 raise
             done += int(Xc.shape[0])
-            from hpnn_tpu.utils import trace as trace_mod
-
             trace_mod.trace(f"w@{done}", weights)
             if state_path:
                 host_w = tuple(np.asarray(w) for w in weights)
@@ -381,8 +380,6 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 for f in files
             )
         )
-        from hpnn_tpu.utils import trace as trace_mod
-
         for i, (fname, sample) in enumerate(pairs):
             log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
             if sample is None:
@@ -752,8 +749,6 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
     _flush()
 
     from hpnn_tpu.utils.glibc_random import shuffled_order
-
-    from hpnn_tpu.utils import trace as trace_mod
 
     for idx in shuffled_order(conf.seed, len(files)):
         fname = files[idx]

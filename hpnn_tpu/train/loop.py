@@ -173,10 +173,12 @@ def train_epoch(
     min_iter: int = MIN_BP_ITER,
     max_iter: int = MAX_BP_ITER,
 ):
-    """The driver's fused-round body: scan-over-samples with the
-    per-sample convergence loop inside, dispatched to the Mosaic
-    kernel body on TPU/f32 (:func:`_pallas_epoch_default`) and the
-    lax body elsewhere.  NOTE for trajectory bookkeeping: the two
+    """Programmatic fused-round entry (bench.py and embedders): the
+    same body dispatch the driver performs — the Mosaic kernel on
+    TPU/f32 (:func:`_pallas_epoch_default`), the lax body elsewhere.
+    (driver.train_kernel implements the dispatch itself so it can also
+    fall back mid-round on a Mosaic refusal and bind the body into the
+    crash-resume key.)  NOTE for trajectory bookkeeping: the two
     bodies are iteration-for-iteration equal in interpret mode
     (tests/test_pallas.py) but NOT bit-identical on hardware — Mosaic
     and XLA reduce the error/softmax sums in different orders (each a
