@@ -574,6 +574,25 @@ def main(argv=None) -> None:
             out["value"] = b["samples_per_s"]["median"]
             out["vs_baseline"] = out["batch_vs_baseline"]
 
+    # HPNN_METRICS: the bench subprocesses/rounds inherit the knob, so
+    # the run's structured events land in the sink — record where, and
+    # fold obs_report's machine summary in (best-effort: a torn sink
+    # must not sink the benchmark figures)
+    from hpnn_tpu import obs
+
+    if obs.enabled():
+        out["obs_metrics_file"] = obs.sink_path()
+        obs.flush()
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import obs_report
+
+            out["obs_summary"] = obs_report.summarize(
+                obs_report.load_events(obs.sink_path()))
+        except Exception as exc:
+            out["obs_summary_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -621,6 +640,8 @@ def main(argv=None) -> None:
                 for k, v in b["prod_slope_60k_bank"].items()
             }
     compact["detail_file"] = detail_path
+    if "obs_metrics_file" in out:
+        compact["obs_metrics_file"] = out["obs_metrics_file"]
     print(json.dumps(compact))
 
 

@@ -17,11 +17,16 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
-        argv, flags=("batch",), valued=("mesh", "profile")
+        argv, flags=("batch",), valued=("mesh", "profile", "metrics")
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
         return -1
+    if "metrics" in opts:
+        # --metrics PATH == HPNN_METRICS=PATH (the flag wins)
+        from hpnn_tpu import obs
+
+        obs.configure(opts["metrics"])
     tp_mesh = None
     if "mesh" in opts:
         if opts.get("batch"):

@@ -18,11 +18,17 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
-        argv, valued=("batch", "epochs", "mesh", "profile", "lr")
+        argv, valued=("batch", "epochs", "mesh", "profile", "lr", "metrics")
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
         return -1
+    if "metrics" in opts:
+        # --metrics PATH == HPNN_METRICS=PATH (the flag wins): the
+        # structured JSONL side channel, never the stdout tokens
+        from hpnn_tpu import obs
+
+        obs.configure(opts["metrics"])
     for needs_batch in ("epochs", "lr"):
         if "batch" not in opts and needs_batch in opts:
             # per-sample mode keeps the reference's fixed learning

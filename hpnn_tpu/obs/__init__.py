@@ -1,0 +1,57 @@
+"""hpnn_tpu.obs — structured metrics & tracing for the TPU port.
+
+The byte-stable stdout token protocol (utils/logging.py) is the
+reference-faithful surface and must never change; this package is the
+structured side channel next to it:
+
+* a lightweight metrics registry (counters, gauges, timers,
+  histograms) with a JSONL event sink gated by ``HPNN_METRICS=<path>``
+  — zero overhead when unset, stdout never touched
+  (obs/registry.py; lint: tools/check_tokens.py);
+* ``jax.profiler`` named-scope annotations so device profiles
+  attribute time to protocol phases (obs/profiler.py);
+* a run-report summarizer over the JSONL (tools/obs_report.py).
+
+Typical instrumentation site::
+
+    from hpnn_tpu import obs
+
+    with obs.timer("driver.chunk_dispatch", size=chunk, body="lax"):
+        weights, stats = train_epoch(...)
+    obs.observe("train.n_iter", stats[1], chunk_end=done)
+    obs.count("fallback.mosaic_refusal")
+
+Event-name catalog and schema: docs/observability.md.
+"""
+
+from hpnn_tpu.obs.profiler import annotate, step_annotation
+from hpnn_tpu.obs.registry import (
+    ENV_KNOB,
+    configure,
+    count,
+    enabled,
+    event,
+    flush,
+    gauge,
+    observe,
+    sink_path,
+    summary,
+    timer,
+    _reset_for_tests,
+)
+
+__all__ = [
+    "ENV_KNOB",
+    "annotate",
+    "configure",
+    "count",
+    "enabled",
+    "event",
+    "flush",
+    "gauge",
+    "observe",
+    "sink_path",
+    "step_annotation",
+    "summary",
+    "timer",
+]

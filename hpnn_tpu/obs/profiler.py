@@ -1,0 +1,58 @@
+"""``jax.profiler`` named-scope annotations for the protocol phases.
+
+The reference leans on external tools for time attribution (``nvcc
+-lineinfo`` + nvprof, ref: configure.ac:535); the TPU-native equivalent
+is an XLA trace (``--profile DIR``, cli/common.py) viewed in
+XProf/TensorBoard.  Those traces show HLO modules, not protocol
+phases — these wrappers name the phases so a device profile can
+attribute time to "fused chunk 3, Pallas body" instead of
+"jit__unnamed".
+
+Two mechanisms, both ~zero cost when no trace is being collected:
+
+* **host annotations** (:func:`annotate`, :func:`step_annotation`) —
+  ``jax.profiler.TraceAnnotation`` / ``StepTraceAnnotation`` around a
+  dispatch on the host timeline; ``StepTraceAnnotation`` additionally
+  drives the profiler's per-step view (chunk index = step number).
+* **trace-time named scopes** — the jitted bodies in train/loop.py,
+  parallel/tp.py and parallel/dp.py wrap themselves in
+  ``jax.named_scope("hpnn.<phase>")`` so the DEVICE-side ops carry the
+  phase name (zero runtime cost — names are baked in at trace time).
+
+Scope-name catalog (docs/observability.md): ``hpnn.fused_chunk``
+(driver chunk dispatch; step = chunk index), ``hpnn.pallas_epoch`` /
+``hpnn.lax_epoch`` (the two fused-round bodies), ``hpnn.sample_loop``
+(per-sample convergence loop), ``hpnn.batch_block`` (batch-mode
+multi-epoch dispatch), ``hpnn.dp_step`` (minibatch step),
+``hpnn.tp_epoch`` / ``hpnn.tp_forward`` / ``hpnn.tp_deltas`` (tensor-
+parallel bodies), ``hpnn.eval_forward`` (batched eval forward).
+
+jax is imported lazily: ``import hpnn_tpu.obs`` stays stdlib-light for
+host programs that only manipulate confs/kernels.
+"""
+
+from __future__ import annotations
+
+from hpnn_tpu.obs.registry import _NULL_CTX
+
+
+def annotate(name: str, **metadata):
+    """Host-side ``TraceAnnotation`` context for one dispatch; a shared
+    no-op when jax (or its profiler) is unavailable."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name, **metadata)
+    except Exception:
+        return _NULL_CTX
+
+
+def step_annotation(name: str, step: int):
+    """``StepTraceAnnotation``: like :func:`annotate` but also feeds the
+    profiler's per-step timeline (we use chunk/block indices)."""
+    try:
+        import jax
+
+        return jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except Exception:
+        return _NULL_CTX

@@ -51,6 +51,12 @@ def global_put(arr, sharding):
     transfer single-process, so every placement below routes through it.
     """
     arr = np.asarray(arr)
+    from hpnn_tpu import obs
+
+    if obs.enabled():
+        with obs.timer("dp.global_put", bytes=int(arr.nbytes)):
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
@@ -68,8 +74,17 @@ def host_fetch(x, mesh):
     identity with a replicated out-sharding performs the all-gather
     (the reference's G2C + ``MPI_Allgather`` before ``ann_dump``,
     ref: src/ann.c:787-856)."""
+    from hpnn_tpu import obs
+
     if getattr(x, "is_fully_addressable", True):
         return np.asarray(x)
+    if obs.enabled():
+        # only the collective path is timed: the conversion above is a
+        # local copy, but this one hides an all-gather over the mesh
+        with obs.timer("dp.host_fetch",
+                       bytes=int(np.dtype(x.dtype).itemsize)
+                       * int(np.prod(x.shape))):
+            return np.asarray(_gather_fn(NamedSharding(mesh, P()))(x))
     return np.asarray(_gather_fn(NamedSharding(mesh, P()))(x))
 
 
@@ -218,12 +233,13 @@ def train_step_math(weights, dw, X, T, *, model: str, momentum: bool,
                     lr: float, alpha: float):
     """One minibatch steepest-descent step + post-update loss — the
     shared body of the per-step jit and the scan-per-epoch trainer."""
-    grads = batch_grads(weights, X, T, model=model)
-    if momentum:
-        weights, dw = momentum_step(weights, dw, grads, lr, alpha)
-    else:
-        weights = sgd_step(weights, grads, lr)
-    loss = batch_loss(weights, X, T, model=model)
+    with jax.named_scope("hpnn.dp_step"):
+        grads = batch_grads(weights, X, T, model=model)
+        if momentum:
+            weights, dw = momentum_step(weights, dw, grads, lr, alpha)
+        else:
+            weights = sgd_step(weights, grads, lr)
+        loss = batch_loss(weights, X, T, model=model)
     return weights, dw, loss
 
 

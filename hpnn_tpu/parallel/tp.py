@@ -55,20 +55,21 @@ def forward_local(weights_loc, x, *, model: str, n_out: int):
     ``exp(z-1)`` (no max subtraction) and the TINY-seeded denominator
     (ref: src/snn.c:282-335) — with padded logits masked out of the sum.
     """
-    acts = [x]
-    v = x
-    last = len(weights_loc) - 1
-    for l, w in enumerate(weights_loc):
-        z_loc = w @ v
-        if model == "snn" and l == last:
-            e_loc = jnp.exp(z_loc - 1.0)
-            e = lax.all_gather(e_loc, MODEL_AXIS, tiled=True)
-            e = e * _out_mask(e.shape[0], n_out, e.dtype)
-            v = e / (TINY + jnp.sum(e))
-        else:
-            v = lax.all_gather(ann.act(z_loc), MODEL_AXIS, tiled=True)
-        acts.append(v)
-    return tuple(acts)
+    with jax.named_scope("hpnn.tp_forward"):
+        acts = [x]
+        v = x
+        last = len(weights_loc) - 1
+        for l, w in enumerate(weights_loc):
+            z_loc = w @ v
+            if model == "snn" and l == last:
+                e_loc = jnp.exp(z_loc - 1.0)
+                e = lax.all_gather(e_loc, MODEL_AXIS, tiled=True)
+                e = e * _out_mask(e.shape[0], n_out, e.dtype)
+                v = e / (TINY + jnp.sum(e))
+            else:
+                v = lax.all_gather(ann.act(z_loc), MODEL_AXIS, tiled=True)
+            acts.append(v)
+        return tuple(acts)
 
 
 def deltas_local(weights_loc, acts, target, *, model: str, k: int):
@@ -79,15 +80,17 @@ def deltas_local(weights_loc, acts, target, *, model: str, k: int):
     ``lax.psum`` — the column-split + allgather of the reference
     (ref: src/ann.c:1279-1592) fused into one reduction.
     """
-    if model == "snn":
-        d = target - acts[-1]  # softmax+CE shortcut (ref: src/snn.c:510-512)
-    else:
-        d = (target - acts[-1]) * ann.dact(acts[-1])
-    ds = [d]
-    for l in range(len(weights_loc) - 1, 0, -1):
-        part = weights_loc[l].T @ _my_block(ds[0], k)
-        ds.insert(0, lax.psum(part, MODEL_AXIS) * ann.dact(acts[l]))
-    return tuple(ds)
+    with jax.named_scope("hpnn.tp_deltas"):
+        if model == "snn":
+            # softmax+CE shortcut (ref: src/snn.c:510-512)
+            d = target - acts[-1]
+        else:
+            d = (target - acts[-1]) * ann.dact(acts[-1])
+        ds = [d]
+        for l in range(len(weights_loc) - 1, 0, -1):
+            part = weights_loc[l].T @ _my_block(ds[0], k)
+            ds.insert(0, lax.psum(part, MODEL_AXIS) * ann.dact(acts[l]))
+        return tuple(ds)
 
 
 def bp_update_local(weights_loc, acts, ds, lr, k: int):
@@ -261,7 +264,8 @@ def make_train_epoch_fn(
                 res.ep0, res.n_iter, res.dep, res.first_ok, res.final_ok
             )
 
-        return lax.scan(body, weights_loc, (X, T))
+        with jax.named_scope("hpnn.tp_epoch"):
+            return lax.scan(body, weights_loc, (X, T))
 
     sharded = jax.shard_map(
         epoch,

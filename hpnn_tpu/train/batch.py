@@ -26,6 +26,7 @@ import sys
 
 import numpy as np
 
+from hpnn_tpu import obs
 from hpnn_tpu.config import NNConf, NNTrain, NNType
 from hpnn_tpu.fileio import samples as sample_io
 from hpnn_tpu.models import kernel as kernel_mod
@@ -572,8 +573,13 @@ def train_kernel_batched(
         conf.seed = int(state["seed"])
         done_epochs = int(state["done"])
         cap_hint = int(state["chunk"])
+        obs.count("resume.restore", done=done_epochs, chunk=cap_hint,
+                  path="batch", body="pallas" if use_pallas else "xla")
         if int(state["resume_done"]) == done_epochs and cap_hint:
-            cap_hint = max(1, cap_hint // 2)
+            halved = max(1, cap_hint // 2)
+            obs.count("batch.cap_halved", reason="resume_stall",
+                      done=done_epochs, old=cap_hint, new=halved)
+            cap_hint = halved
         saved = tuple(
             np.asarray(w, dtype=dtype) for w in state["weights"]
         )
@@ -616,6 +622,14 @@ def train_kernel_batched(
             n,
         )
         log.flush()
+        if obs.enabled():
+            obs.gauge("batch.loss", loss, epoch=epoch)
+            obs.gauge("batch.acc", okc / n, epoch=epoch, ok=okc, n=n)
+
+    obs.event("round.start", mode="batch", samples=n, batch=B,
+              epochs=epochs, body="pallas" if use_pallas else "xla",
+              bank=bank_refresh, data_shards=n_data,
+              resumed=state is not None)
 
     # most recent bank permutation: a sub-R dispatch block (shrunken
     # survival cap) can start mid-refresh-group and must reuse the
@@ -724,10 +738,14 @@ def train_kernel_batched(
                 ),)
             t0 = _time.monotonic()
             try:
-                w_sh, dw_sh, losses, counts = multi_fn(
-                    w_sh, dw_sh, X_dev, T_dev, *data_args)
-                losses = dp.host_fetch(losses, mesh)
-                counts = dp.host_fetch(counts, mesh)
+                with obs.step_annotation("hpnn.batch_block", block_i), \
+                        obs.timer("batch.block_dispatch", epoch=epoch,
+                                  epochs=e_block,
+                                  body="pallas" if use_pallas else "xla"):
+                    w_sh, dw_sh, losses, counts = multi_fn(
+                        w_sh, dw_sh, X_dev, T_dev, *data_args)
+                    losses = dp.host_fetch(losses, mesh)
+                    counts = dp.host_fetch(counts, mesh)
             except Exception as exc:
                 if (
                     block_i == 0
@@ -745,6 +763,8 @@ def train_kernel_batched(
                         "falling back to the XLA step\n",
                         type(exc).__name__,
                     )
+                    obs.count("fallback.mosaic_refusal", path="batch",
+                              epoch=epoch, exc=type(exc).__name__)
                     multi_fn = _build_multi_fn(False)
                     use_pallas = False
                     # re-key the checkpoint to the dispatch actually
@@ -788,7 +808,10 @@ def train_kernel_batched(
             Xe = Xd[order].reshape(n_steps, B, -1)
             Te = Td[order].reshape(n_steps, B, -1)
             Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
-            w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
+            with obs.timer("batch.block_dispatch", epoch=epoch,
+                           epochs=1, body="xla"):
+                w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
+                losses = dp.host_fetch(losses, mesh)
             loss = float(jnp.mean(losses))
             out = np.asarray(eval_fn(w_sh, X_eval))
             okc = accuracy_counts(out, T, model)
@@ -805,6 +828,9 @@ def train_kernel_batched(
     # left alone, same discipline as the fused-round driver)
     if state_path and _load_fuse_state(state_path, state_key) is not None:
         os.remove(state_path)
+    obs.event("round.end", mode="batch", epochs=epochs, loss=loss,
+              body="pallas" if use_pallas else "xla")
+    obs.summary()
     return True
 
 
@@ -855,7 +881,11 @@ def run_kernel_batched(conf: NNConf) -> None:
     from hpnn_tpu.utils import debug
 
     debug.device_alloc_report(weights)
-    out = np.asarray(eval_fn(weights, jnp.asarray(X.astype(dtype))))
+    with obs.annotate("hpnn.eval_forward"), \
+            obs.timer("eval.batch_forward", size=len(names)):
+        out = np.asarray(eval_fn(weights, jnp.asarray(X.astype(dtype))))
+    obs.event("eval.round", files=len(all_files), batched=len(names),
+              odd=0, unreadable=len(all_files) - len(names), tp=False)
 
     from hpnn_tpu.train.driver import print_verdict
     from hpnn_tpu.utils.glibc_random import shuffled_order
@@ -871,3 +901,4 @@ def run_kernel_batched(conf: NNConf) -> None:
         print_verdict(out[i], T[i], model)
         trace_mod.trace(f"out@{name}", [out[i]])
     log.flush()
+    obs.summary()

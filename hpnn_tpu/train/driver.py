@@ -29,6 +29,7 @@ import sys
 
 import numpy as np
 
+from hpnn_tpu import obs
 from hpnn_tpu.config import NNConf, NNTrain, NNType
 from hpnn_tpu.fileio import samples as sample_io
 from hpnn_tpu.models import kernel as kernel_mod
@@ -262,6 +263,8 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             # attempt — see the JaxRuntimeError handler)
             done = int(state["done"])
             chunk = int(state["chunk"])
+            obs.count("resume.restore", done=done, chunk=chunk,
+                      body="pallas" if use_pallas_epoch else "lax")
             restored = tuple(
                 jnp.asarray(w, dtype=dtype) for w in state["weights"]
             )
@@ -289,13 +292,22 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 # JaxRuntimeError handler and its chunk-halving hint:
                 # halve here so a deterministically-over-budget chunk
                 # shrinks instead of retrying at the same size forever
-                chunk = max(min(32, chunk), chunk // 2)
+                halved = max(min(32, chunk), chunk // 2)
+                if halved != chunk:
+                    obs.count("fuse.chunk_halved", reason="resume_stall",
+                              done=done, old=chunk, new=halved)
+                chunk = halved
             # mark this position as resumed (and cover the
             # killed-before-first-save case with an initial checkpoint)
             _save_fuse_state(
                 state_path, state_key, conf.seed, done, chunk, host_w,
                 resume_done=done,
             )
+        obs.event(
+            "round.start", mode="fused", samples=int(X.shape[0]),
+            chunk=chunk, body="pallas" if use_pallas_epoch else "lax",
+            resumed=state is not None,
+        )
         fname_it = iter(zip(files, readable))
 
         def emit_header_only_until_readable(silent=False):
@@ -312,12 +324,20 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         for _ in range(done):  # resume: skip the already-printed part
             if emit_header_only_until_readable(silent=True) is None:
                 break
+        chunk_i = 0  # dispatch ordinal — the profiler's step number
         while done < X.shape[0]:
             Xc = X[done : done + chunk]
             Tc = T[done : done + chunk]
+            body = "pallas" if use_pallas_epoch else "lax"
             try:
-                weights, stats = train_epoch(weights, dw0, Xc, Tc)
-                stats = tuple(np.asarray(s) for s in stats)
+                # the timer brackets dispatch AND the stats fetch (the
+                # host transfer is the fence — same discipline as
+                # bench.py), so `dt` is real wall time per chunk
+                with obs.step_annotation("hpnn.fused_chunk", chunk_i), \
+                        obs.timer("driver.chunk_dispatch", done=done,
+                                  size=int(Xc.shape[0]), body=body):
+                    weights, stats = train_epoch(weights, dw0, Xc, Tc)
+                    stats = tuple(np.asarray(s) for s in stats)
             except Exception as exc:
                 if use_pallas_epoch and "UNAVAILABLE" not in str(exc):
                     # Mosaic refused the fused-epoch kernel (the
@@ -333,6 +353,8 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                         "falling back to the lax body\n",
                         type(exc).__name__,
                     )
+                    obs.count("fallback.mosaic_refusal", done=done,
+                              exc=type(exc).__name__)
                     use_pallas_epoch = False
                     if state_path:
                         state_key = _make_key(False)
@@ -349,12 +371,28 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                     # halve for the next attempt, but never above the
                     # configured size and not below a 32-sample floor
                     # (or the configured size, whichever is smaller)
+                    next_chunk = max(min(32, chunk), chunk // 2)
+                    obs.count("fuse.chunk_halved", reason="dispatch_crash",
+                              done=done, old=chunk, new=next_chunk,
+                              exc=type(exc).__name__)
                     _save_fuse_state(
                         state_path, state_key, conf.seed, done,
-                        max(min(32, chunk), chunk // 2), host_w,
+                        next_chunk, host_w,
                     )
+                obs.event("round.abort", mode="fused", done=done,
+                          exc=type(exc).__name__)
+                obs.flush()
                 raise
             done += int(Xc.shape[0])
+            chunk_i += 1
+            if obs.enabled():
+                # stats are already host numpy (fetched for the token
+                # printer) — recording them costs no extra device sync
+                obs.observe("train.n_iter", stats[1], chunk_end=done)
+                obs.count("train.samples", n=int(Xc.shape[0]))
+                obs.count("train.first_ok", n=int(stats[3].sum()))
+                obs.count("train.final_ok", n=int(stats[4].sum()))
+                obs.gauge("fuse.chunk_size", chunk, done=done)
             trace_mod.trace(f"w@{done}", weights)
             if state_path:
                 host_w = tuple(np.asarray(w) for w in weights)
@@ -370,6 +408,9 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 _print_train_tokens(res, model, momentum)
         # trailing unreadable files still get their header lines
         emit_header_only_until_readable()
+        obs.event("round.end", mode="fused", samples=done,
+                  chunks=chunk_i, body="pallas" if use_pallas_epoch
+                  else "lax")
     else:
         # streaming path; reuse pre-parsed samples when a fused attempt
         # bailed (zero trainable samples — all entries None) rather
@@ -380,6 +421,12 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 for f in files
             )
         )
+        obs.event("round.start", mode="streaming", samples=len(files))
+        # per-round convergence stats; the token printer already syncs
+        # every per-sample scalar, so collecting them is free — but
+        # only collect when the sink is live (zero-overhead rule)
+        n_iters = [] if obs.enabled() else None
+        first_oks = final_oks = 0
         for i, (fname, sample) in enumerate(pairs):
             log.nn_out(sys.stdout, "TRAINING FILE: %16.16s\t", fname)
             if sample is None:
@@ -390,7 +437,17 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             res = train_one(weights, dw, tr_in, tr_out)
             weights, dw = res.weights, res.dw
             _print_train_tokens(res, model, momentum)
+            if n_iters is not None:
+                n_iters.append(int(res.n_iter))
+                first_oks += int(bool(res.first_ok))
+                final_oks += int(bool(res.final_ok))
             trace_mod.trace(f"w@{i + 1}", weights)
+        if n_iters is not None and n_iters:
+            obs.observe("train.n_iter", n_iters)
+            obs.count("train.samples", n=len(n_iters))
+            obs.count("train.first_ok", n=first_oks)
+            obs.count("train.final_ok", n=final_oks)
+        obs.event("round.end", mode="streaming", samples=len(files))
     if tp_state is not None:
         from hpnn_tpu.parallel import dp, mesh as mesh_mod
 
@@ -407,6 +464,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
     # unrelated checkpoints (different key) are left alone
     if state_path and _load_fuse_state(state_path, state_key) is not None:
         os.remove(state_path)
+    obs.summary()
     return True
 
 
@@ -728,7 +786,9 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
             return
         if batched_fwd is None:
             batched_fwd = _make_batched_fwd()
-        oc = batched_fwd(np.stack(grp_x).astype(dtype))
+        with obs.annotate("hpnn.eval_forward"), \
+                obs.timer("eval.batch_forward", size=len(grp_files)):
+            oc = batched_fwd(np.stack(grp_x).astype(dtype))
         for j, f in enumerate(grp_files):
             out_of[f] = oc[j]
         grp_files.clear()
@@ -748,6 +808,10 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
                 _flush()
     _flush()
 
+    obs.event("eval.round", files=len(files), batched=len(out_of),
+              odd=len(odd), unreadable=len(bad),
+              tp=sharded is not None)
+
     from hpnn_tpu.utils.glibc_random import shuffled_order
 
     for idx in shuffled_order(conf.seed, len(files)):
@@ -764,6 +828,7 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
             print_verdict(o, tr_out, model)
         trace_mod.trace(f"out@{fname}", [o])
         log.flush()
+    obs.summary()
 
 
 def print_verdict(out: np.ndarray, target: np.ndarray, model: str) -> None:

@@ -186,19 +186,23 @@ def train_epoch(
     N_ITER tokens can differ near convergence thresholds within the
     same band as the recorded f32-vs-f64 drift.  HPNN_PALLAS=0
     reproduces the r01–r04 XLA streams exactly."""
+    from hpnn_tpu import obs
+
     if _pallas_epoch_default(weights):
         from hpnn_tpu.ops import pallas_train
 
-        return pallas_train.train_epoch_fused(
+        with obs.annotate("hpnn.pallas_epoch"):
+            return pallas_train.train_epoch_fused(
+                weights, dw0, X, T, alpha, delta,
+                model=model, momentum=momentum,
+                min_iter=min_iter, max_iter=max_iter,
+            )
+    with obs.annotate("hpnn.lax_epoch"):
+        return train_epoch_lax(
             weights, dw0, X, T, alpha, delta,
             model=model, momentum=momentum,
             min_iter=min_iter, max_iter=max_iter,
         )
-    return train_epoch_lax(
-        weights, dw0, X, T, alpha, delta,
-        model=model, momentum=momentum,
-        min_iter=min_iter, max_iter=max_iter,
-    )
 
 
 def train_sample(
@@ -262,18 +266,19 @@ def train_sample_lax(
         w, acts, dep = mod.train_iteration(w, acts, x, target)
         return w, m, acts, dep
 
-    return convergence_loop(
-        one_iteration,
-        jnp.argmax,
-        weights,
-        dw,
-        acts0,
-        ep0,
-        target_argmax(target),
-        delta,
-        min_iter=min_iter,
-        max_iter=max_iter,
-    )
+    with jax.named_scope("hpnn.sample_loop"):
+        return convergence_loop(
+            one_iteration,
+            jnp.argmax,
+            weights,
+            dw,
+            acts0,
+            ep0,
+            target_argmax(target),
+            delta,
+            min_iter=min_iter,
+            max_iter=max_iter,
+        )
 
 
 @functools.partial(jax.jit, static_argnames=("model",))
@@ -330,5 +335,8 @@ def train_epoch_lax(
             res.ep0, res.n_iter, res.dep, res.first_ok, res.final_ok
         )
 
-    weights, stats = jax.lax.scan(body, weights, (X, T))
+    # trace-time scope: names the scan's HLO ops in device profiles
+    # (no runtime cost — docs/observability.md scope catalog)
+    with jax.named_scope("hpnn.lax_epoch"):
+        weights, stats = jax.lax.scan(body, weights, (X, T))
     return weights, stats

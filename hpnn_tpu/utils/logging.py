@@ -86,4 +86,9 @@ def nn_write(fp, fmt: str, *args) -> None:
 
 
 def flush() -> None:
+    # both streams: nn_error/nn_warn write to stderr, and a crash path
+    # that flushed only stdout could lose the very diagnostics
+    # explaining the crash (stderr is unbuffered when a tty, but NOT
+    # when redirected to a file — the tutorial-monitor case)
     sys.stdout.flush()
+    sys.stderr.flush()

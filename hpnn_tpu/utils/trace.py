@@ -32,8 +32,28 @@ import numpy as np
 from hpnn_tpu.utils import logging as log
 
 
+# HPNN_TRACE is read ONCE and memoized: enabled() sits inside the
+# per-sample token loops (driver streaming path calls trace() per
+# sample), and a getenv per call is a dict lookup + string compare paid
+# 60k times per round for a knob that cannot meaningfully change
+# mid-process.  Tests flip the env var, so they reset the memo through
+# _reset_enabled_cache() (tests/conftest.py does it around every test).
+_enabled_memo: bool | None = None
+
+
 def enabled() -> bool:
-    return os.environ.get("HPNN_TRACE", "") not in ("", "0")
+    global _enabled_memo
+    e = _enabled_memo
+    if e is None:
+        e = os.environ.get("HPNN_TRACE", "") not in ("", "0")
+        _enabled_memo = e
+    return e
+
+
+def _reset_enabled_cache() -> None:
+    """Test-only: forget the memoized HPNN_TRACE reading."""
+    global _enabled_memo
+    _enabled_memo = None
 
 
 def trace(tag: str, arrays) -> None:

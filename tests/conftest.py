@@ -36,3 +36,18 @@ def _reset_verbosity():
     from hpnn_tpu.utils import logging as log
 
     log.set_verbose(0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_obs_memos():
+    """The HPNN_TRACE memo (utils/trace.py) and the HPNN_METRICS sink
+    (obs/registry.py) are read-once process state; tests flip those env
+    vars per-test, so both memos reset around every test."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.utils import trace
+
+    trace._reset_enabled_cache()
+    obs._reset_for_tests()
+    yield
+    trace._reset_enabled_cache()
+    obs._reset_for_tests()

@@ -1,0 +1,44 @@
+"""tools/check_tokens.py — the byte-stability lint, wired as tier-1.
+
+The lint runs a tiny train+eval round with and without ``HPNN_METRICS``
+and fails when the stdout token stream differs by a byte (or the sink
+misses a tentpole event).  Running it here makes any instrumentation
+regression a test failure, not a post-hoc discovery."""
+
+import importlib.util
+import os
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_tokens",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_tokens.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tokens_byte_stable_under_instrumentation(tmp_path):
+    mod = _load()
+    failures = mod.check(str(tmp_path))
+    assert failures == []
+
+
+def test_lint_catches_a_perturbed_stream(tmp_path, monkeypatch):
+    """The lint must actually bite: a fake obs leak into stdout (or a
+    missing sink event) turns into a non-empty failure list."""
+    mod = _load()
+
+    real = mod._run_round
+
+    def leaky(tmpdir, metrics_path):
+        out = real(tmpdir, metrics_path)
+        if metrics_path is not None:
+            out += '{"ev": "leak", "kind": "event"}\n'
+        return out
+
+    monkeypatch.setattr(mod, "_run_round", leaky)
+    failures = mod.check(str(tmp_path))
+    assert any("byte-identical" in f for f in failures)

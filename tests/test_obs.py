@@ -1,0 +1,323 @@
+"""hpnn_tpu.obs — the structured metrics side channel.
+
+The registry must be invisible when ``HPNN_METRICS`` is unset, and when
+set it must record the fused-round story — dispatch timers, chunk
+timeline, fallback/resume counters, n_iter histograms — in emission
+order, without ever touching the stdout token stream."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs
+from hpnn_tpu.config import NNConf, NNTrain, NNType
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.train import driver, loop
+from hpnn_tpu.utils import logging as log
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _conf(tmp_path, n=6):
+    rng = np.random.RandomState(0)
+    sdir = tmp_path / "samples"
+    sdir.mkdir(exist_ok=True)
+    for i in range(n):
+        c = i % 2
+        x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
+            + 0.1 * rng.normal(size=8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        with open(sdir / f"s{i:05d}.txt", "w") as fp:
+            fp.write("[input] 8\n" + " ".join(f"{v:.5f}" for v in x) + "\n")
+            fp.write("[output] 2\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return NNConf(name="t", type=NNType.ANN, seed=1, kernel=k,
+                  train=NNTrain.BP, samples=str(sdir), tests=str(sdir))
+
+
+# ---------------------------------------------------------------- registry
+
+def test_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    assert not obs.enabled()
+    assert obs.sink_path() is None
+    obs.event("x")
+    obs.count("x")
+    obs.gauge("x", 1.0)
+    obs.observe("x", [1, 2])
+    with obs.timer("x"):
+        pass
+    obs.summary()
+    obs.flush()
+    assert list(tmp_path.iterdir()) == []  # nothing written anywhere
+
+
+def test_timer_disabled_is_shared_noop(monkeypatch):
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    assert obs.timer("a") is obs.timer("b")  # the shared _NULL_CTX
+
+
+def test_emit_kinds_and_totals(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    assert obs.enabled()
+    assert obs.sink_path() == str(sink)
+    obs.event("round.start", mode="test")
+    obs.count("c", n=2)
+    obs.count("c", n=3, reason="again")
+    obs.gauge("g", 7.5)
+    obs.observe("h", [1, 2, 3, 4], tag="t")
+    with obs.timer("t1", size=4):
+        pass
+    obs.summary()
+    recs = _read(sink)
+    by = {}
+    for r in recs:
+        by.setdefault(r["ev"], []).append(r)
+    assert by["round.start"][0]["kind"] == "event"
+    assert by["round.start"][0]["mode"] == "test"
+    # counter lines carry increment + running total, in order
+    assert [(r["n"], r["total"]) for r in by["c"]] == [(2, 2), (3, 5)]
+    assert by["g"][0]["value"] == 7.5
+    h = by["h"][0]
+    assert (h["kind"], h["n"], h["min"], h["max"]) == ("hist", 4, 1.0, 4.0)
+    t = by["t1"][0]
+    assert t["kind"] == "timer" and t["dt"] >= 0 and t["size"] == 4
+    s = by["obs.summary"][0]
+    assert s["counters"] == {"c": 5}
+    assert s["gauges"] == {"g": 7.5}
+    assert s["aggregates"]["h"]["n"] == 4
+    assert s["aggregates"]["h"]["total"] == 10.0
+    # log2 buckets: 1->bucket 1 (frexp exp), 2->2, 3,4->... just check sum
+    assert sum(s["aggregates"]["h"]["log2_buckets"].values()) == 4
+    assert s["aggregates"]["t1"]["n"] == 1
+
+
+def test_timer_tags_failures(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    with pytest.raises(ValueError):
+        with obs.timer("boom"):
+            raise ValueError("x")
+    recs = _read(sink)
+    assert recs[-1]["ev"] == "boom" and recs[-1]["failed"] == "ValueError"
+
+
+def test_rank_placeholder(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.{rank}.jsonl"))
+    obs._reset_for_tests()
+    assert obs.sink_path() == str(tmp_path / "m.0.jsonl")
+
+
+def test_configure_points_and_clears(tmp_path, monkeypatch):
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    sink = tmp_path / "c.jsonl"
+    obs.configure(str(sink))
+    assert obs.enabled() and os.environ["HPNN_METRICS"] == str(sink)
+    obs.event("hello")
+    obs.configure(None)
+    assert not obs.enabled() and "HPNN_METRICS" not in os.environ
+    assert any(r["ev"] == "hello" for r in _read(sink))
+
+
+def test_bad_sink_path_disables_not_crashes(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv(
+        "HPNN_METRICS", str(tmp_path / "no" / "such" / "dir" / "m.jsonl"))
+    obs._reset_for_tests()
+    assert not obs.enabled()
+    obs.event("x")  # still a no-op, no raise
+    out = capsys.readouterr()
+    assert out.out == ""          # stdout untouched, always
+    assert "metrics disabled" in out.err
+
+
+# ------------------------------------------------------- instrumented round
+
+def test_fused_round_emits_the_tentpole_events(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    conf = _conf(tmp_path)
+    assert driver.train_kernel(conf)
+    driver.run_kernel(conf)
+    recs = _read(sink)
+    names = [r["ev"] for r in recs]
+    # acceptance events: dispatch latency, chunk timeline, n_iter hist
+    assert "driver.chunk_dispatch" in names
+    assert "fuse.chunk_size" in names
+    assert "train.n_iter" in names
+    assert "eval.round" in names
+    start = next(r for r in recs if r["ev"] == "round.start")
+    end = next(r for r in recs if r["ev"] == "round.end")
+    assert start["mode"] == "fused" and start["samples"] == 6
+    assert end["samples"] == 6
+    hist = next(r for r in recs if r["ev"] == "train.n_iter")
+    assert hist["n"] == 6 and hist["min"] >= 1
+    cnt = next(r for r in recs if r["ev"] == "train.samples")
+    assert cnt["total"] == 6
+    summaries = [r for r in recs if r["ev"] == "obs.summary"]
+    assert summaries and summaries[-1]["aggregates"]["train.n_iter"]["n"] == 6
+    assert summaries[-1]["aggregates"]["driver.chunk_dispatch"]["n"] >= 1
+
+
+def test_round_stdout_is_byte_identical_with_metrics_on(
+        tmp_path, monkeypatch, capsys):
+    log.set_verbose(2)
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    conf = _conf(tmp_path)
+    assert driver.train_kernel(conf)
+    plain = capsys.readouterr().out
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    obs._reset_for_tests()
+    conf = _conf(tmp_path)
+    assert driver.train_kernel(conf)
+    assert capsys.readouterr().out == plain
+    assert plain.count("TRAINING FILE") == 6
+
+
+def test_mosaic_refusal_event_order(tmp_path, monkeypatch):
+    """A Mosaic refusal mid-round must leave this exact story in the
+    sink: pallas round.start -> failed dispatch timer -> one
+    fallback.mosaic_refusal -> successful lax dispatches -> round.end
+    on the lax body."""
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+
+    # pretend the Mosaic epoch body is eligible, then have it refuse
+    monkeypatch.setattr(loop, "_pallas_epoch_default", lambda w: True)
+    from hpnn_tpu.ops import pallas_train
+
+    def refuse(*a, **k):
+        raise RuntimeError("Mosaic lowering failed (simulated)")
+
+    monkeypatch.setattr(pallas_train, "train_epoch_fused", refuse)
+
+    conf = _conf(tmp_path)
+    assert driver.train_kernel(conf)
+
+    recs = _read(sink)
+    names = [r["ev"] for r in recs]
+    assert names.count("fallback.mosaic_refusal") == 1
+    start = next(r for r in recs if r["ev"] == "round.start")
+    assert start["body"] == "pallas"
+    i_fail = names.index("driver.chunk_dispatch")
+    assert recs[i_fail]["failed"] == "RuntimeError"
+    assert recs[i_fail]["body"] == "pallas"
+    i_fb = names.index("fallback.mosaic_refusal")
+    assert i_fail < i_fb
+    fb = recs[i_fb]
+    assert fb["total"] == 1 and fb["exc"] == "RuntimeError"
+    # the retried dispatch (lax body) lands AFTER the fallback marker
+    ok_dispatches = [
+        r for r in recs if r["ev"] == "driver.chunk_dispatch"
+        and "failed" not in r
+    ]
+    assert ok_dispatches and all(r["body"] == "lax" for r in ok_dispatches)
+    assert recs.index(ok_dispatches[0]) > i_fb
+    end = next(r for r in recs if r["ev"] == "round.end")
+    assert end["body"] == "lax"
+
+
+def test_chunk_halving_and_resume_events(tmp_path, monkeypatch):
+    """A dispatch crash (JaxRuntimeError) under HPNN_FUSE_STATE must
+    emit fuse.chunk_halved + round.abort in the crashing run, and the
+    resumed run must emit resume.restore with the HALVED chunk."""
+    import jax
+
+    sink = tmp_path / "m.jsonl"
+    state = tmp_path / "fuse_state.npz"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(state))
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "128")  # halving floor is 64
+    obs._reset_for_tests()
+
+    real = loop.train_epoch_lax
+    boom = {"armed": True}
+
+    def crash_once(*a, **k):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise jax.errors.JaxRuntimeError("worker crashed (simulated)")
+        return real(*a, **k)
+
+    monkeypatch.setattr(loop, "train_epoch_lax", crash_once)
+    conf = _conf(tmp_path)
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        driver.train_kernel(conf)
+
+    recs = _read(sink)
+    names = [r["ev"] for r in recs]
+    halv = recs[names.index("fuse.chunk_halved")]
+    assert halv["reason"] == "dispatch_crash"
+    assert (halv["old"], halv["new"]) == (128, 64)
+    assert names.index("fuse.chunk_halved") < names.index("round.abort")
+
+    # second attempt: resumes from the checkpoint at the halved chunk
+    obs._reset_for_tests()  # fresh stream position (append mode)
+    conf2 = _conf(tmp_path)
+    assert driver.train_kernel(conf2)
+    recs2 = _read(sink)[len(recs):]
+    names2 = [r["ev"] for r in recs2]
+    res = recs2[names2.index("resume.restore")]
+    assert res["done"] == 0 and res["chunk"] == 64
+    assert names2.index("resume.restore") < names2.index("round.start")
+    start2 = next(r for r in recs2 if r["ev"] == "round.start")
+    assert start2["resumed"] is True
+    assert next(r for r in recs2 if r["ev"] == "round.end")["samples"] == 6
+    assert not state.exists()  # completed round dropped its checkpoint
+
+
+# ----------------------------------------------------------------- report
+
+def test_obs_report_renders_a_round(tmp_path, monkeypatch):
+    import importlib.util
+
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    conf = _conf(tmp_path)
+    assert driver.train_kernel(conf)
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "obs_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rep = mod.summarize(mod.load_events(str(sink)))
+    assert rep["counters"]["train.samples"] == 6
+    assert rep["histograms"]["train.n_iter"]["n"] == 6
+    assert rep["chunk_timeline"] and rep["chunk_timeline"][0]["size"] == 6
+    assert rep["summary"] is not None
+    text = mod.render(rep)
+    assert "driver.chunk_dispatch" in text
+    assert "histogram train.n_iter" in text
+    assert "fused chunk timeline" in text
+
+
+def test_cli_metrics_flag_maps_to_configure(tmp_path, monkeypatch):
+    """--metrics PATH on the CLIs is obs.configure(PATH)."""
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    from hpnn_tpu.cli import common
+
+    argv, opts = common.extract_long_opts(
+        ["--metrics", str(tmp_path / "m.jsonl"), "nn.conf"],
+        valued=("batch", "epochs", "mesh", "profile", "lr", "metrics"),
+    )
+    assert argv == ["nn.conf"] and opts["metrics"].endswith("m.jsonl")
+    obs.configure(opts["metrics"])
+    assert obs.enabled() and obs.sink_path() == opts["metrics"]

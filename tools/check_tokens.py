@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Byte-stability lint for the stdout token protocol.
+
+The ``NN: `` / ``NN(WARN): `` / ``NN(ERR): `` / ``#DBG: acc[`` token
+lines are the reference's de-facto metrics API — tutorial monitors grep
+them, so the structured obs subsystem (``hpnn_tpu/obs/``) must never
+perturb them.  This lint proves it the direct way: it runs the same
+tiny train+eval round TWICE in-process — once with ``HPNN_METRICS``
+unset, once with it pointed at a JSONL sink — and asserts
+
+1. the two stdout captures are **byte-identical**,
+2. the token lines match the golden shapes (``TRAINING FILE``,
+   ``init=``/``end=``/``iter=``, ``TESTING FILE``, PASS/FAIL verdicts),
+3. no line smells of JSON or obs vocabulary (the sink never leaks),
+4. the instrumented run's sink is non-empty and carries the tentpole
+   events (dispatch timer, chunk gauge, n_iter histogram, round
+   events).
+
+Run standalone (exit code for CI)::
+
+    JAX_PLATFORMS=cpu python tools/check_tokens.py
+
+or via the tier-1 suite (tests/test_check_tokens.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import re
+import sys
+import tempfile
+
+TOKEN_PREFIXES = ("NN: ", "NN(WARN): ", "NN(ERR): ", "NN(DBG): ",
+                  "#DBG: acc[")
+
+# every stdout line of a -vv ANN train+eval round must match one of
+# these (ref token formats: driver._print_train_tokens/print_verdict)
+GOLDEN = [
+    re.compile(r"^NN: TRAINING FILE: .{1,16}\t"
+               r" init= *[0-9.+-]+ (OK|NO) N_ITER= *\d+"
+               r" final= *[0-9.+-]+( (SUCCESS!|FAIL!))?$"),
+    re.compile(r"^NN: TESTING FILE: .{1,16}\t"
+               r"( BEST CLASS idx=\d+ P= *[0-9.+-]+)?"
+               r" \[(PASS|FAIL( idx=\d+)?)\]$"),
+    re.compile(r"^NN\((WARN|ERR|DBG)\): .*$"),
+    re.compile(r"^#DBG: acc\[.+\]=[0-9.]+$"),
+    re.compile(r"^$"),
+]
+
+
+def _tiny_conf(tmpdir: str):
+    """A 6-sample 8->5->2 ANN BP round (the test_trace.py shape)."""
+    import numpy as np
+
+    from hpnn_tpu.config import NNConf, NNTrain, NNType
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    rng = np.random.RandomState(0)
+    sdir = os.path.join(tmpdir, "samples")
+    os.makedirs(sdir, exist_ok=True)
+    for i in range(6):
+        c = i % 2
+        x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
+            + 0.1 * rng.normal(size=8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        with open(os.path.join(sdir, f"s{i:05d}.txt"), "w") as fp:
+            fp.write("[input] 8\n"
+                     + " ".join(f"{v:.5f}" for v in x) + "\n")
+            fp.write("[output] 2\n"
+                     + " ".join(f"{v:.1f}" for v in t) + "\n")
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return NNConf(name="t", type=NNType.ANN, seed=1, kernel=k,
+                  train=NNTrain.BP, samples=sdir, tests=sdir)
+
+
+def _run_round(tmpdir: str, metrics_path: str | None) -> str:
+    """One train+eval round, stdout captured; returns the capture."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.train import driver
+    from hpnn_tpu.utils import logging as log
+
+    obs.configure(metrics_path)  # sets/clears HPNN_METRICS + memo
+    conf = _tiny_conf(tmpdir)
+    log.set_verbose(2)
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            if not driver.train_kernel(conf):
+                raise RuntimeError("train_kernel failed")
+            driver.run_kernel(conf)
+    finally:
+        log.set_verbose(0)
+        obs.configure(None)
+    return buf.getvalue()
+
+
+def check(tmpdir: str) -> list[str]:
+    """Run the lint; returns a list of failure strings (empty = pass)."""
+    failures = []
+    sink = os.path.join(tmpdir, "obs.jsonl")
+    plain = _run_round(os.path.join(tmpdir, "a"), None)
+    instrumented = _run_round(os.path.join(tmpdir, "b"), sink)
+
+    if plain != instrumented:
+        failures.append(
+            "stdout is NOT byte-identical with HPNN_METRICS set "
+            f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
+    if not plain.strip():
+        failures.append("no stdout captured — the round emitted nothing")
+
+    for line in plain.splitlines():
+        if not any(g.match(line) for g in GOLDEN):
+            failures.append(f"unexpected stdout line shape: {line!r}")
+        if line and not line.startswith(TOKEN_PREFIXES):
+            failures.append(f"non-token stdout line: {line!r}")
+        if '"ev"' in line or '"kind"' in line or line.startswith("{"):
+            failures.append(f"obs JSON leaked into stdout: {line!r}")
+
+    if not os.path.exists(sink):
+        failures.append("instrumented run produced no metrics sink")
+        return failures
+    with open(sink) as fp:
+        recs = [json.loads(ln) for ln in fp if ln.strip()]
+    if not recs:
+        failures.append("metrics sink is empty")
+    names = {r.get("ev") for r in recs}
+    for want in ("round.start", "driver.chunk_dispatch", "train.n_iter",
+                 "fuse.chunk_size", "round.end", "obs.summary"):
+        if want not in names:
+            failures.append(f"metrics sink missing event {want!r}")
+    return failures
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # standalone invocation (python tools/check_tokens.py): make the
+    # repo root importable like the test runner does
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    with tempfile.TemporaryDirectory() as tmpdir:
+        failures = check(tmpdir)
+    if failures:
+        for f in failures:
+            sys.stderr.write(f"check_tokens: FAIL: {f}\n")
+        return 1
+    sys.stderr.write("check_tokens: OK — stdout tokens byte-stable, "
+                     "sink populated\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+#!/usr/bin/env python3
+"""Render an ``HPNN_METRICS`` JSONL sink into a run report.
+
+Usage::
+
+    python tools/obs_report.py run.metrics.jsonl          # text report
+    python tools/obs_report.py run.metrics.jsonl --json   # machine form
+
+Reads the event stream produced by ``hpnn_tpu.obs`` (schema:
+docs/observability.md) and prints, in order: the run header, lifecycle
+events, counter totals, timer stats, histograms (with ASCII log2-bucket
+bars), the fused-round chunk-dispatch timeline, and the
+fallback/resume event log in emission order.
+
+stdlib-only on purpose: the report must render on a login node with no
+jax installed, and ``bench.py`` imports :func:`summarize` in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# kinds whose per-line records we keep verbatim for the ordered logs
+_FALLBACK_EVS = (
+    "fallback.",
+    "fuse.chunk_halved",
+    "batch.cap_halved",
+    "resume.restore",
+    "round.abort",
+)
+
+
+def load_events(path: str) -> list[dict]:
+    """Parse the JSONL sink, skipping lines a crash may have truncated."""
+    events = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line from a crashed writer
+    return events
+
+
+def _merge_hist(dst: dict, rec: dict) -> None:
+    n = int(rec.get("n", 0))
+    dst["n"] = dst.get("n", 0) + n
+    if not n:
+        return
+    dst["sum"] = dst.get("sum", 0.0) + float(rec.get("sum", 0.0))
+    for k, pick in (("min", min), ("max", max)):
+        v = rec.get(k)
+        if v is not None:
+            dst[k] = pick(dst[k], v) if k in dst else v
+
+
+def summarize(events: list[dict]) -> dict:
+    """Fold the stream into one report dict (the --json output)."""
+    rep = {
+        "events": {},       # point-event name -> occurrences
+        "counters": {},     # counter name -> final running total
+        "timers": {},       # timer name -> {n, total, mean, min, max}
+        "histograms": {},   # hist name -> merged batch stats
+        "gauges": {},       # gauge name -> last value
+        "chunk_timeline": [],   # fused-round dispatch latency timeline
+        "fallback_log": [],     # ordered fallback/resume/halving records
+        "summary": None,        # LAST obs.summary record (cumulative)
+        "rounds": [],           # round.start/round.end/eval.round events
+    }
+    for rec in events:
+        ev = rec.get("ev", "?")
+        kind = rec.get("kind", "event")
+        if kind == "summary":
+            rep["summary"] = rec
+            continue
+        if kind == "count":
+            rep["counters"][ev] = rec.get("total", 0)
+        elif kind == "gauge":
+            rep["gauges"][ev] = rec.get("value")
+        elif kind == "timer":
+            t = rep["timers"].setdefault(ev, {"n": 0, "total": 0.0})
+            dt = float(rec.get("dt", 0.0))
+            t["n"] += 1
+            t["total"] += dt
+            t["min"] = min(t.get("min", dt), dt)
+            t["max"] = max(t.get("max", dt), dt)
+            if ev == "driver.chunk_dispatch":
+                rep["chunk_timeline"].append({
+                    "done": rec.get("done"),
+                    "size": rec.get("size"),
+                    "body": rec.get("body"),
+                    "dt": dt,
+                    "failed": rec.get("failed"),
+                })
+        elif kind == "hist":
+            _merge_hist(rep["histograms"].setdefault(ev, {}), rec)
+        else:
+            rep["events"][ev] = rep["events"].get(ev, 0) + 1
+            if ev.startswith(("round.", "eval.")):
+                rep["rounds"].append(rec)
+        if ev.startswith(_FALLBACK_EVS[0]) or ev in _FALLBACK_EVS[1:]:
+            rep["fallback_log"].append(rec)
+    for t in rep["timers"].values():
+        t["mean"] = t["total"] / t["n"] if t["n"] else 0.0
+    # the cumulative aggregates in the last summary carry the exact
+    # per-name log2 buckets — surface them beside the per-line merges
+    if rep["summary"]:
+        for name, agg in rep["summary"].get("aggregates", {}).items():
+            if name in rep["histograms"]:
+                rep["histograms"][name]["log2_buckets"] = agg.get(
+                    "log2_buckets", {})
+                rep["histograms"][name]["mean"] = agg.get("mean")
+    return rep
+
+
+def _bar(count: int, peak: int, width: int = 30) -> str:
+    if peak <= 0:
+        return ""
+    return "#" * max(1, int(round(width * count / peak)))
+
+
+def _bucket_label(k: int) -> str:
+    # bucket k holds values in (2^(k-1), 2^k]; k=0 holds v <= 0
+    return "<=0" if k == 0 else f"<=2^{k}"
+
+
+def render(rep: dict) -> str:
+    out = []
+    w = out.append
+    w("== hpnn obs report ==")
+    s = rep.get("summary")
+    if s:
+        w(f"uptime: {s.get('uptime_s', '?')} s"
+          f"   (summary lines use the cumulative aggregates)")
+    for rec in rep["rounds"]:
+        fields = {k: v for k, v in rec.items()
+                  if k not in ("ts", "ev", "kind")}
+        w(f"  {rec['ev']}: " + ", ".join(
+            f"{k}={v}" for k, v in fields.items()))
+    if rep["events"]:
+        w("")
+        w("-- events --")
+        for name, n in sorted(rep["events"].items()):
+            w(f"  {name:32s} x{n}")
+    if rep["counters"]:
+        w("")
+        w("-- counters (final totals) --")
+        for name, total in sorted(rep["counters"].items()):
+            w(f"  {name:32s} {total}")
+    if rep["gauges"]:
+        w("")
+        w("-- gauges (last value) --")
+        for name, v in sorted(rep["gauges"].items()):
+            w(f"  {name:32s} {v}")
+    if rep["timers"]:
+        w("")
+        w("-- timers --")
+        w(f"  {'name':32s} {'n':>6s} {'total_s':>10s} {'mean_s':>10s}"
+          f" {'min_s':>10s} {'max_s':>10s}")
+        for name, t in sorted(rep["timers"].items()):
+            w(f"  {name:32s} {t['n']:6d} {t['total']:10.4f}"
+              f" {t['mean']:10.4f} {t.get('min', 0.0):10.4f}"
+              f" {t.get('max', 0.0):10.4f}")
+    for name, h in sorted(rep["histograms"].items()):
+        w("")
+        w(f"-- histogram {name} --")
+        n = h.get("n", 0)
+        mean = h.get("mean")
+        if mean is None and n:
+            mean = h.get("sum", 0.0) / n
+        w(f"  n={n}  mean={mean if mean is None else round(mean, 4)}"
+          f"  min={h.get('min')}  max={h.get('max')}")
+        buckets = h.get("log2_buckets") or {}
+        if buckets:
+            peak = max(buckets.values())
+            for k in sorted(buckets, key=int):
+                c = buckets[k]
+                w(f"  {_bucket_label(int(k)):>8s} {c:8d} "
+                  f"{_bar(c, peak)}")
+    if rep["chunk_timeline"]:
+        w("")
+        w("-- fused chunk timeline --")
+        w(f"  {'done':>8s} {'size':>6s} {'body':>7s} {'dt_s':>9s}")
+        for c in rep["chunk_timeline"]:
+            flag = f"  FAILED({c['failed']})" if c.get("failed") else ""
+            w(f"  {str(c['done']):>8s} {str(c['size']):>6s}"
+              f" {str(c['body']):>7s} {c['dt']:9.4f}{flag}")
+    if rep["fallback_log"]:
+        w("")
+        w("-- fallback / resume log (emission order) --")
+        for rec in rep["fallback_log"]:
+            fields = {k: v for k, v in rec.items()
+                      if k not in ("ts", "ev", "kind", "total")}
+            w(f"  {rec['ev']}: " + ", ".join(
+                f"{k}={v}" for k, v in fields.items()))
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize an HPNN_METRICS JSONL sink")
+    ap.add_argument("path", help="metrics JSONL file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON instead of text")
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.path)
+    except OSError as exc:
+        sys.stderr.write(f"obs_report: {exc}\n")
+        return 1
+    rep = summarize(events)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
