@@ -593,6 +593,20 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["obs_summary_error"] = repr(exc)
 
+    # Serving smoke (tools/bench_serve.py --smoke): p50/p99 latency +
+    # throughput of the resident serving stack on a tiny kernel —
+    # best-effort like the obs fold-in (a serving hiccup must not sink
+    # the training figures).  HPNN_BENCH_NO_SERVE=1 skips it.
+    if not os.environ.get("HPNN_BENCH_NO_SERVE"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import bench_serve
+
+            out["serve_smoke"] = bench_serve.run_smoke()
+        except Exception as exc:
+            out["serve_smoke_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -639,6 +653,11 @@ def main(argv=None) -> None:
                 k: v["us_per_step_median"]
                 for k, v in b["prod_slope_60k_bank"].items()
             }
+    if "serve_smoke" in out:
+        sm = out["serve_smoke"]
+        compact["serve_p50_ms"] = sm["latency_ms"]["p50"]
+        compact["serve_p99_ms"] = sm["latency_ms"]["p99"]
+        compact["serve_rps"] = sm["throughput_rps"]
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:
         compact["obs_metrics_file"] = out["obs_metrics_file"]

@@ -44,6 +44,7 @@ __all__ = [
     "train_kernel_batched",
     "run_kernel_batched",
     "read_sample",
+    "serve",
 ]
 
 # The execute-ops (`_NN(train,kernel)` / `_NN(run,kernel)`,
@@ -61,6 +62,12 @@ _LAZY = {
 
 
 def __getattr__(name):
+    if name == "serve":
+        # the serving subsystem (docs/serving.md) — jax-free to
+        # import, resolved lazily like the execute-ops
+        import importlib
+
+        return importlib.import_module("hpnn_tpu.serve")
     if name in _LAZY:
         import importlib
 

@@ -110,12 +110,26 @@ class profile_trace:
 def validate_long_opts(opts: dict) -> bool:
     """Value checks for the TPU-side long options; prints the CLI's
     usual ``syntax error`` style instead of raising."""
-    for name in ("batch", "epochs"):
+    for name in ("batch", "epochs", "max-batch"):
         v = opts.get(name)
         if v is None or v is True:
             continue
         if not str(v).isdigit() or int(v) < 1:
             sys.stderr.write(f"syntax error: bad --{name} parameter!\n")
+            return False
+    port = opts.get("port")
+    if port is not None:
+        if not str(port).isdigit() or int(port) > 65535:
+            sys.stderr.write("syntax error: bad --port parameter!\n")
+            return False
+    wait = opts.get("max-wait-ms")
+    if wait is not None:
+        try:
+            ok = float(wait) >= 0.0
+        except ValueError:
+            ok = False
+        if not ok:
+            sys.stderr.write("syntax error: bad --max-wait-ms parameter!\n")
             return False
     mesh = opts.get("mesh")
     if mesh is not None:

@@ -1,0 +1,101 @@
+"""``serve_nn`` — keep a conf's kernel resident behind the HTTP front end.
+
+The third driver next to ``train_nn``/``run_nn``: where ``run_nn``
+pays process start + kernel load + XLA compile per invocation,
+``serve_nn`` loads the conf's kernel once, warmup-compiles the bucket
+menu, and answers ``POST /v1/infer`` until stopped.  The single-dash
+flag grammar stays the reference's; serving knobs are TPU-side long
+options:
+
+    serve_nn [-v] [--port N] [--host H] [--max-batch N]
+             [--max-wait-ms F] [--metrics PATH] nn.conf
+
+stdout stays silent (the token protocol belongs to train/run rounds);
+all serving diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from hpnn_tpu import config, runtime
+from hpnn_tpu.cli import common
+
+_MODEL_OF = {"ANN": "ann", "SNN": "snn"}
+
+
+def build_from_conf(conf, *, max_batch: int = 64, n_buckets: int = 4,
+                    max_wait_ms: float = 2.0, host: str = "127.0.0.1",
+                    port: int = 0):
+    """(session, server) for ``conf``'s kernel — the testable core of
+    ``main``.  The kernel registers under ``conf.name``; port 0 binds
+    an ephemeral port (read ``server.server_address``)."""
+    from hpnn_tpu import serve
+
+    if conf.kernel is None:
+        raise ValueError("conf has no kernel (missing [init] line?)")
+    model = _MODEL_OF.get(conf.type.name)
+    if model is None:
+        raise ValueError(f"cannot serve kernel type {conf.type.name}")
+    session = serve.Session(max_batch=max_batch, n_buckets=n_buckets,
+                            max_wait_ms=max_wait_ms)
+    name = conf.name or "default"
+    session.register_kernel(name, conf.kernel, model=model)
+    server = serve.make_server(session, host=host, port=port)
+    return session, server
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    common.install_sigpipe_handler()
+    runtime.init_all(1)
+    argv, opts = common.extract_long_opts(
+        argv,
+        valued=("port", "host", "max-batch", "max-wait-ms", "metrics"),
+    )
+    if argv is None or not common.validate_long_opts(opts):
+        runtime.deinit_all()
+        return -1
+    if "metrics" in opts:
+        from hpnn_tpu import obs
+
+        obs.configure(opts["metrics"])
+    filename = common.parse_args(argv, "serve_nn")
+    if filename is None:
+        runtime.deinit_all()
+        return 0
+    conf = config.load_conf(filename)
+    if conf is None:
+        sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    try:
+        session, server = build_from_conf(
+            conf,
+            max_batch=int(opts.get("max-batch", 64)),
+            max_wait_ms=float(opts.get("max-wait-ms", 2.0)),
+            host=opts.get("host", "127.0.0.1"),
+            port=int(opts.get("port", 8700)),
+        )
+    except (ValueError, OSError) as exc:
+        sys.stderr.write(f"serve_nn: cannot start: {exc}\n")
+        runtime.deinit_all()
+        return -1
+    host, port = server.server_address[:2]
+    sys.stderr.write(
+        f"serve_nn: kernel {session.kernels()[0]!r} resident, "
+        f"buckets {list(session.engine.buckets)}, "
+        f"listening on {host}:{port}\n")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        session.close()
+        runtime.deinit_all()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

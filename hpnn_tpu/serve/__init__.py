@@ -1,0 +1,42 @@
+"""Resident inference serving (`hpnn_tpu.serve`).
+
+The reference embeds a trained kernel in a host program and queries it
+"on the fly"; this package keeps that kernel (or several) *resident*
+behind a micro-batching queue and a bucketed compile cache, so many
+concurrent small queries amortize into device-efficient batches with
+zero steady-state compiles.  Layers, bottom up:
+
+* :mod:`~hpnn_tpu.serve.registry` — named kernels, validation,
+  hot-reload (version-bumped immutable entries);
+* :mod:`~hpnn_tpu.serve.engine` — power-of-two shape buckets, one
+  cached forward per (kernel, version, bucket, dtype): AOT-compiled
+  vmap on throughput backends, the bitwise-exact per-sample path in
+  CPU parity mode;
+* :mod:`~hpnn_tpu.serve.batcher` — bounded coalescing queue with
+  deadlines and explicit backpressure;
+* :mod:`~hpnn_tpu.serve.server` — :class:`Session` (the in-process
+  embedding API) and the stdlib HTTP front end.
+
+``import hpnn_tpu.serve`` is jax-free (stdlib + numpy); jax loads on
+the first compile, same discipline as ``hpnn_tpu.obs``.  Architecture
+and semantics: docs/serving.md.
+"""
+
+from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull
+from hpnn_tpu.serve.engine import Engine, bucket_for, bucket_menu
+from hpnn_tpu.serve.registry import Entry, Registry, RegistryError
+from hpnn_tpu.serve.server import Session, make_server
+
+__all__ = [
+    "Batcher",
+    "DeadlineExceeded",
+    "QueueFull",
+    "Engine",
+    "bucket_menu",
+    "bucket_for",
+    "Entry",
+    "Registry",
+    "RegistryError",
+    "Session",
+    "make_server",
+]

@@ -1,0 +1,270 @@
+"""Dynamic micro-batching queue for the resident serving layer.
+
+Concurrent callers each bring a few rows of inference work; the device
+wants one big dispatch.  The batcher sits between them: requests park
+in a bounded FIFO, a drain loop coalesces everything that arrived
+within ``max_wait_ms`` (or as soon as ``max_batch`` rows are pending)
+into one batch, hands it to a ``dispatch`` callable, and fans the
+per-request results back out through per-request events.
+
+Semantics, in order of precedence:
+
+* **Backpressure** — the queue holds at most ``max_depth`` requests;
+  a submit beyond that raises :class:`QueueFull` immediately
+  (retriable — the caller should back off and resubmit, the HTTP
+  front end maps it to 429).
+* **Deadlines** — every request carries an absolute deadline
+  (``timeout_s`` from submit time).  The drain loop drops expired
+  requests *before* dispatch and completes them with
+  :class:`DeadlineExceeded`; a request can also time out while
+  waiting on its event.
+* **Coalescing** — the drain loop takes the oldest request, then
+  greedily appends queued requests while the summed row count stays
+  ≤ ``max_batch``.  A batch closes early when the oldest request has
+  waited ``max_wait_ms``.
+
+Everything here is stdlib-only and clock-injectable: tests drive a
+stopped batcher with a fake ``clock`` and the public
+:meth:`Batcher.drain_once`, so coalescing/deadline/backpressure are
+asserted without sleeping.  obs instrumentation (queue-depth gauge,
+batch-size / wait-time histograms) rides the existing ``HPNN_METRICS``
+knob and never touches stdout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Sequence
+
+from hpnn_tpu import obs
+
+
+class QueueFull(RuntimeError):
+    """Queue at max_depth — retriable, resubmit after backoff."""
+
+    retriable = True
+
+
+class DeadlineExceeded(TimeoutError):
+    """Request expired before (or while) being served — retriable."""
+
+    retriable = True
+
+
+class _Request:
+    __slots__ = ("payload", "rows", "deadline", "submitted",
+                 "event", "result", "error")
+
+    def __init__(self, payload, rows, deadline, submitted):
+        self.payload = payload
+        self.rows = rows              # device cost: how many batch rows
+        self.deadline = deadline      # absolute, in clock() units
+        self.submitted = submitted
+        self.event = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+    def finish(self, result=None, error: BaseException | None = None):
+        self.result = result
+        self.error = error
+        self.event.set()
+
+
+class Batcher:
+    """Coalesce concurrent requests into bounded micro-batches.
+
+    ``dispatch(payloads) -> results`` receives the payload list of one
+    batch and must return one result per payload (same order).  It
+    runs on the drain thread; an exception fails every request in the
+    batch (the error propagates to each waiter).
+
+    ``clock`` must be a monotonic float-seconds callable; tests inject
+    a fake.  With ``start=False`` no thread runs — call
+    :meth:`drain_once` manually.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[list[Any]], Sequence[Any]],
+        *,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        max_depth: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "default",
+        start: bool = True,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        self._dispatch = dispatch
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait_ms) / 1e3
+        self.max_depth = int(max_depth)
+        self._clock = clock
+        self.name = name
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque[_Request] = deque()
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._drain_loop, name=f"hpnn-batcher-{name}",
+                daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ submit
+    def submit(self, payload, *, rows: int = 1,
+               timeout_s: float = 5.0) -> _Request:
+        """Enqueue one request; returns its ticket (wait via
+        :meth:`result`).  Raises :class:`QueueFull` when the queue is
+        at ``max_depth``."""
+        if rows < 1:
+            raise ValueError("rows must be >= 1")
+        now = self._clock()
+        req = _Request(payload, int(rows), now + float(timeout_s), now)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError(f"batcher {self.name!r} is closed")
+            if len(self._queue) >= self.max_depth:
+                obs.count("serve.rejected", batcher=self.name,
+                          reason="queue_full")
+                raise QueueFull(
+                    f"batcher {self.name!r} queue at max_depth="
+                    f"{self.max_depth}; retry later")
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cond.notify()
+        obs.gauge("serve.queue_depth", depth, batcher=self.name)
+        return req
+
+    def result(self, req: _Request, *, timeout_s: float | None = None):
+        """Block until ``req`` completes; returns its result or raises
+        its error.  ``timeout_s`` bounds the wall-clock wait (real
+        time, independent of the injected clock)."""
+        if not req.event.wait(timeout_s):
+            raise DeadlineExceeded(
+                f"request not served within {timeout_s}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def infer(self, payload, *, rows: int = 1, timeout_s: float = 5.0):
+        """submit + result in one call (the common embedding path)."""
+        req = self.submit(payload, rows=rows, timeout_s=timeout_s)
+        # small slack past the request deadline: the drain loop is the
+        # authority on expiry; this wait is just a liveness backstop
+        return self.result(req, timeout_s=float(timeout_s) + 1.0)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ------------------------------------------------------------ drain
+    def _take_batch(self, block: bool) -> list[_Request] | None:
+        """Pop one coalesced batch (or None).  Expired requests are
+        completed with DeadlineExceeded and never dispatched."""
+        with self._cond:
+            if block:
+                while not self._queue and not self._closed:
+                    self._cond.wait(timeout=self.max_wait)
+            if not self._queue:
+                return None
+            now = self._clock()
+            # the batch closes early once the oldest waiter has aged
+            # max_wait; otherwise wait for more arrivals (blocking
+            # mode only — drain_once never sleeps)
+            if block:
+                oldest = self._queue[0]
+                pending = sum(r.rows for r in self._queue)
+                while (pending < self.max_batch and not self._closed
+                       and now - oldest.submitted < self.max_wait):
+                    remaining = self.max_wait - (now - oldest.submitted)
+                    self._cond.wait(timeout=max(remaining, 1e-4))
+                    now = self._clock()
+                    pending = sum(r.rows for r in self._queue)
+            batch: list[_Request] = []
+            expired: list[_Request] = []
+            rows = 0
+            while self._queue:
+                req = self._queue[0]
+                if req.deadline <= now:
+                    expired.append(self._queue.popleft())
+                    continue
+                if batch and rows + req.rows > self.max_batch:
+                    break
+                batch.append(self._queue.popleft())
+                rows += req.rows
+            depth = len(self._queue)
+        for req in expired:
+            obs.count("serve.deadline_exceeded", batcher=self.name)
+            req.finish(error=DeadlineExceeded(
+                "request expired in queue before dispatch"))
+        if expired:
+            obs.gauge("serve.queue_depth", depth, batcher=self.name)
+        return batch or None
+
+    def drain_once(self, *, block: bool = False) -> int:
+        """Coalesce and dispatch one batch; returns the number of
+        requests served (0 when the queue was empty/all-expired).
+        Public so fake-clock tests can step the batcher
+        deterministically without a drain thread."""
+        batch = self._take_batch(block)
+        if not batch:
+            return 0
+        now = self._clock()
+        obs.observe("serve.wait_ms",
+                    [(now - r.submitted) * 1e3 for r in batch],
+                    batcher=self.name)
+        obs.observe("serve.batch_size", [sum(r.rows for r in batch)],
+                    batcher=self.name, requests=len(batch))
+        try:
+            results = self._dispatch([r.payload for r in batch])
+            if len(results) != len(batch):
+                raise RuntimeError(
+                    f"dispatch returned {len(results)} results for "
+                    f"{len(batch)} requests")
+        except BaseException as exc:  # fail the whole batch
+            obs.count("serve.batch_failed", batcher=self.name,
+                      requests=len(batch))
+            for req in batch:
+                req.finish(error=exc)
+            return len(batch)
+        for req, res in zip(batch, results):
+            req.finish(result=res)
+        obs.gauge("serve.queue_depth", self.depth(), batcher=self.name)
+        return len(batch)
+
+    def _drain_loop(self):
+        while True:
+            with self._lock:
+                if self._closed and not self._queue:
+                    return
+            try:
+                self.drain_once(block=True)
+            except Exception:
+                # the loop must survive anything; per-request errors
+                # were already delivered in drain_once
+                obs.count("serve.drain_error", batcher=self.name)
+
+    # ------------------------------------------------------------ close
+    def close(self, *, timeout_s: float = 5.0):
+        """Stop accepting work; drain what's queued, then join."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        # complete anything still parked (e.g. no drain thread)
+        while True:
+            with self._cond:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            req.finish(error=RuntimeError(
+                f"batcher {self.name!r} closed"))

@@ -1,0 +1,288 @@
+"""Execution core: bucketed shapes, one cached forward per bucket.
+
+``run_nn`` pays a fresh XLA trace+compile for every new input shape it
+meets.  A resident server cannot: with arbitrary per-request row
+counts the compile cache would grow without bound and every novel
+batch size would stall the queue behind XLA.  The engine therefore
+quantizes every batch to a small fixed menu of power-of-two row-count
+**buckets** (default 4: ``max_batch / 2^3 … max_batch``, e.g.
+8/16/32/64) and keeps exactly one cached executable per
+``(kernel, version, bucket, dtype)``.  Warmup fills the whole menu at
+startup so steady-state serving never compiles again — the acceptance
+invariant the obs ``serve.compile`` counter proves.
+
+Two dispatch modes, selected per engine (``HPNN_SERVE_MODE`` or the
+``mode=`` argument; default by backend):
+
+* ``"compiled"`` (TPU/GPU default) — the bucket executable is an
+  ahead-of-time ``jax.jit(...).lower(...).compile()`` of the
+  per-sample ``models/ann.py``/``models/snn.py`` ``run`` vmapped over
+  the padded ``(bucket, n_in)`` block, under
+  ``jax.default_matmul_precision("float32")`` — the same HIGHEST pin
+  ``train/batch.py``'s batched eval uses.  The padded input buffer is
+  donated (skipped on CPU, where XLA does not support donation and
+  would warn per dispatch).
+* ``"parity"`` (CPU default) — the bucket entry runs each row through
+  the SAME eager per-sample ``model.run`` call the ``run_nn`` driver
+  makes, so served outputs are **bitwise-equal** to direct
+  ``ann.forward`` and a request's answer never depends on what it was
+  coalesced with.  This is deliberate, not a fallback: XLA only
+  guarantees run-to-run determinism for a *fixed* executable — the
+  LLVM codegen of the same tiny per-row GEMV changes with the
+  enclosing program (measured: a ``lax.map`` body flips its dot
+  codegen at ≥57 rows on CPU, and even a single-row jit differs from
+  eager on ~0.3% of inputs by 1 ulp) — so no compiled batch program
+  can promise bitwise parity with the eager reference path across all
+  bucket sizes.  Exactness costs per-row dispatch overhead, the right
+  trade for the CPU correctness backend; throughput backends use
+  ``"compiled"``.
+
+Both modes share the bucket menu, the cache-key discipline, and the
+obs counters, so the steady-state no-compiles-after-warmup invariant
+is asserted identically.  jax is imported lazily inside the class so
+``import hpnn_tpu.serve`` stays jax-free (same discipline as
+``hpnn_tpu/obs``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from hpnn_tpu import obs
+from hpnn_tpu.serve.registry import Entry, Registry
+
+DEFAULT_MAX_BATCH = 64
+DEFAULT_N_BUCKETS = 4
+_MODES = ("parity", "compiled")
+
+
+def bucket_menu(max_batch: int = DEFAULT_MAX_BATCH,
+                n_buckets: int = DEFAULT_N_BUCKETS) -> tuple[int, ...]:
+    """Ascending power-of-two bucket sizes ending at ``max_batch``
+    (rounded up to a power of two), e.g. (8, 16, 32, 64)."""
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1")
+    top = 1
+    while top < max_batch:
+        top *= 2
+    menu = []
+    b = top
+    for _ in range(max(1, int(n_buckets))):
+        menu.append(b)
+        if b == 1:
+            break
+        b //= 2
+    return tuple(sorted(menu))
+
+
+def bucket_for(menu: tuple[int, ...], rows: int) -> int:
+    """Smallest bucket holding ``rows``; the largest when none does
+    (the caller then chunks the batch)."""
+    for b in menu:
+        if rows <= b:
+            return b
+    return menu[-1]
+
+
+class Engine:
+    """Pads batches into buckets and runs the compiled forwards.
+
+    One engine serves every kernel in ``registry``; executables are
+    cached per ``(name, version, bucket, dtype)`` so a registry
+    hot-reload (version bump) transparently compiles fresh code while
+    the old version's executables age out untouched.
+    """
+
+    def __init__(self, registry: Registry, *,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 n_buckets: int = DEFAULT_N_BUCKETS,
+                 mode: str | None = None):
+        if mode is None:
+            mode = os.environ.get("HPNN_SERVE_MODE") or None
+        if mode is not None and mode not in _MODES:
+            raise ValueError(f"unknown serve mode {mode!r} "
+                             f"(want {'|'.join(_MODES)})")
+        self.registry = registry
+        self.max_batch = int(max_batch)
+        self.buckets = bucket_menu(max_batch, n_buckets)
+        self._mode = mode          # resolved lazily: needs the backend
+        self._lock = threading.Lock()
+        self._compiled: dict[tuple, object] = {}
+        self._weights_cache: dict[tuple, tuple] = {}
+
+    @property
+    def mode(self) -> str:
+        """"parity" | "compiled"; backend-defaulted on first use (the
+        lazy resolve keeps ``import hpnn_tpu.serve`` jax-free)."""
+        if self._mode is None:
+            import jax
+
+            self._mode = ("parity" if jax.default_backend() == "cpu"
+                          else "compiled")
+        return self._mode
+
+    # ------------------------------------------------------------ compile
+    def _device_weights(self, entry: Entry):
+        """Entry weights as device arrays, cached per (name, version)."""
+        import jax.numpy as jnp
+
+        key = (entry.name, entry.version)
+        with self._lock:
+            w = self._weights_cache.get(key)
+        if w is None:
+            w = tuple(jnp.asarray(np.asarray(a)) for a in
+                      entry.kernel.weights)
+            with self._lock:
+                self._weights_cache[key] = w
+        return w
+
+    def _compiled_forward(self, entry: Entry, bucket: int, dtype):
+        """The cached ``(R ≤ bucket, n_in) -> (R, n_out)`` forward for
+        ``entry``.  Fills (and counts) the cache at most once per
+        (name, version, bucket, dtype).
+
+        compiled mode: an AOT executable over the padded
+        ``(bucket, n_in)`` block.  parity mode: a host closure running
+        each row through the eager per-sample ``model.run`` — exactly
+        the ``run_nn`` numerics (module docstring)."""
+        import jax
+
+        dtype = np.dtype(dtype)
+        key = (entry.name, entry.version, bucket, dtype.str)
+        with self._lock:
+            fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        if entry.model == "snn":
+            from hpnn_tpu.models import snn as model
+        else:
+            from hpnn_tpu.models import ann as model
+
+        if self.mode == "parity":
+            # the HOST weights, verbatim: ``ann.run`` on numpy weights
+            # computes its first-layer GEMV in numpy BLAS and the rest
+            # in eager XLA — the parity contract is "exactly what the
+            # embedded per-sample caller gets", so the closure must
+            # hold the same array types that caller would pass
+            with obs.timer("serve.compile_time", kernel=entry.name,
+                           bucket=bucket):
+                def fn(xs, _w=entry.kernel.weights, _run=model.run):
+                    return np.stack(
+                        [np.asarray(_run(_w, x)) for x in xs])
+        else:
+            weights = self._device_weights(entry)
+            def batch_forward(xs):
+                return jax.vmap(lambda x: model.run(weights, x))(xs)
+
+            # CPU XLA does not implement buffer donation (it would
+            # emit a warning per dispatch); everywhere else the padded
+            # input buffer is dead after the forward, so donate it.
+            donate = () if jax.default_backend() == "cpu" else (0,)
+            shape = jax.ShapeDtypeStruct((bucket, entry.n_inputs),
+                                         dtype)
+            with obs.timer("serve.compile_time", kernel=entry.name,
+                           bucket=bucket):
+                # the same HIGHEST matmul pin as batch.make_eval_fn
+                with jax.default_matmul_precision("float32"):
+                    fn = (jax.jit(batch_forward, donate_argnums=donate)
+                          .lower(shape).compile())
+        obs.count("serve.compile", kernel=entry.name,
+                  version=entry.version, bucket=bucket, dtype=dtype.str,
+                  mode=self.mode)
+        with self._lock:
+            # a racing fill of the same key is harmless (identical
+            # executable); last writer wins
+            self._compiled[key] = fn
+        return fn
+
+    def warmup(self, names=None, *, dtype=None) -> int:
+        """Compile the full bucket menu for ``names`` (default: every
+        registered kernel).  Returns the number of executables now
+        resident.  Steady-state serving after warmup never compiles —
+        the obs ``serve.compile`` total stays at
+        ``len(names) * len(self.buckets)``."""
+        names = self.registry.names() if names is None else list(names)
+        n = 0
+        for name in names:
+            entry = self.registry.get(name)
+            dt = dtype or np.asarray(entry.kernel.weights[0]).dtype
+            for bucket in self.buckets:
+                self._compiled_forward(entry, bucket, dt)
+                n += 1
+        obs.event("serve.warmup", kernels=len(names),
+                  buckets=len(self.buckets))
+        return n
+
+    # ------------------------------------------------------------ run
+    def run_rows(self, entry: Entry, rows: np.ndarray) -> np.ndarray:
+        """Forward ``rows`` (R, n_in) → (R, n_out) through the bucket
+        menu: quantize to the smallest fitting bucket, or chunk through
+        the largest one when R exceeds it.  compiled mode pads the
+        block up to the bucket's fixed shape; parity mode hands the
+        exact rows to the per-row closure (no shape constraint, no
+        wasted forwards on padding)."""
+        rows = np.asarray(rows)
+        if rows.ndim != 2 or rows.shape[1] != entry.n_inputs:
+            raise ValueError(
+                f"rows must be (R, {entry.n_inputs}); got {rows.shape}")
+        dtype = np.asarray(entry.kernel.weights[0]).dtype
+        rows = rows.astype(dtype, copy=False)
+        out = np.empty((rows.shape[0], entry.n_outputs), dtype=dtype)
+        top = self.buckets[-1]
+        start = 0
+        while start < rows.shape[0]:
+            n = min(rows.shape[0] - start, top)
+            bucket = bucket_for(self.buckets, n)
+            obs.count("serve.bucket_hit", kernel=entry.name,
+                      bucket=bucket, rows=n)
+            fn = self._compiled_forward(entry, bucket, dtype)
+            if self.mode == "compiled" and n < bucket:
+                block = np.zeros((bucket, entry.n_inputs), dtype=dtype)
+                block[:n] = rows[start:start + n]
+            else:
+                block = rows[start:start + n]
+            res = np.asarray(fn(block))
+            out[start:start + n] = res[:n]
+            start += n
+        return out
+
+    def dispatch(self, entry_name: str, payloads) -> list[np.ndarray]:
+        """Batcher dispatch hook: concatenate the payload row blocks,
+        run them through one (or a few) bucket dispatches, split the
+        results back per payload."""
+        entry = self.registry.get(entry_name)
+        blocks = [np.atleast_2d(np.asarray(p)) for p in payloads]
+        for b in blocks:
+            if b.shape[1] != entry.n_inputs:
+                raise ValueError(
+                    f"payload width {b.shape[1]} != kernel n_inputs "
+                    f"{entry.n_inputs}")
+        counts = [b.shape[0] for b in blocks]
+        with obs.timer("serve.forward", kernel=entry_name,
+                       rows=sum(counts)):
+            out = self.run_rows(entry, np.concatenate(blocks, axis=0))
+        results = []
+        start = 0
+        for c in counts:
+            results.append(out[start:start + c])
+            start += c
+        return results
+
+    # ------------------------------------------------------------ misc
+    def compiled_count(self) -> int:
+        with self._lock:
+            return len(self._compiled)
+
+    def evict(self, name: str, *, keep_version: int | None = None):
+        """Drop cached executables/weights for ``name`` (all versions,
+        or all but ``keep_version``).  Reload housekeeping."""
+        with self._lock:
+            for key in [k for k in self._compiled
+                        if k[0] == name and k[1] != keep_version]:
+                del self._compiled[key]
+            for key in [k for k in self._weights_cache
+                        if k[0] == name and k[1] != keep_version]:
+                del self._weights_cache[key]

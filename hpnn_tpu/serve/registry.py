@@ -1,0 +1,157 @@
+"""Named multi-kernel registry for the resident serving layer.
+
+The reference libhpnn is built to be *embedded*: a host scientific
+code keeps one trained kernel resident and queries it "on the fly"
+(ref: /root/reference/README.md:10-34).  A serving process generalizes
+that to N named kernels, loaded once and kept hot, with an explicit
+hot-reload path so a trainer can overwrite ``kernel.opt`` on disk and
+the server picks the new weights up without a restart — the serving
+twin of the tutorials' dump-then-``[init] kernel.opt`` resume cycle.
+
+Entries are immutable snapshots (``Entry``); a reload produces a NEW
+entry with a bumped ``version``, so the engine's compile cache — keyed
+by ``(name, version, bucket, dtype)`` — naturally compiles fresh
+executables for the new weights while in-flight batches finish on the
+old ones.  stdlib + numpy only; jax stays out of this module.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import NamedTuple
+
+from hpnn_tpu import obs
+from hpnn_tpu.models import kernel as kernel_mod
+
+
+class RegistryError(ValueError):
+    pass
+
+
+class Entry(NamedTuple):
+    """One resident kernel: an immutable snapshot of (weights, type).
+
+    ``version`` increments on every (re)load of the same name —
+    the engine keys compiled executables on it.  ``path``/``mtime``
+    are None for kernels registered from memory (no reload source).
+    """
+
+    name: str
+    kernel: kernel_mod.Kernel
+    model: str               # "ann" | "snn" (the forward dispatch)
+    version: int
+    path: str | None
+    mtime: float | None
+
+    @property
+    def n_inputs(self) -> int:
+        return self.kernel.n_inputs
+
+    @property
+    def n_outputs(self) -> int:
+        return self.kernel.n_outputs
+
+
+def _check_model(model: str) -> str:
+    if model not in ("ann", "snn"):
+        raise RegistryError(f"unknown model type {model!r} (want ann|snn)")
+    return model
+
+
+class Registry:
+    """Thread-safe name → :class:`Entry` map.
+
+    ``register`` installs in-memory weights; ``load`` reads a kernel
+    text file through the standard loader (``models.kernel.load`` →
+    ``fileio.kernel_format``) and remembers the path + mtime so
+    ``maybe_reload``/``reload`` can refresh it.  Every install runs
+    ``kernel.validate`` — a serving process must never hold a kernel
+    whose layer chain is inconsistent.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[str, Entry] = {}
+
+    # ------------------------------------------------------------ install
+    def register(
+        self, name: str, kernel: kernel_mod.Kernel, *, model: str = "ann",
+        path: str | None = None, mtime: float | None = None,
+    ) -> Entry:
+        """Install (or replace) ``name`` with in-memory weights."""
+        _check_model(model)
+        if not kernel_mod.validate(kernel):
+            raise RegistryError(f"kernel {name!r} failed validation")
+        with self._lock:
+            prev = self._entries.get(name)
+            version = prev.version + 1 if prev is not None else 0
+            entry = Entry(name, kernel, model, version, path, mtime)
+            self._entries[name] = entry
+        obs.count("serve.kernel_load", kernel=name, version=version,
+                  source="file" if path else "memory")
+        return entry
+
+    def load(self, name: str, path: str, *, model: str = "ann") -> Entry:
+        """Load a kernel text file and install it under ``name``."""
+        _check_model(model)
+        try:
+            mtime = os.stat(path).st_mtime
+            _fname, kernel = kernel_mod.load(path)
+        except OSError as exc:
+            raise RegistryError(f"cannot read kernel file {path}: {exc}")
+        return self.register(name, kernel, model=model, path=path,
+                             mtime=mtime)
+
+    # ------------------------------------------------------------ lookup
+    def get(self, name: str) -> Entry:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise KeyError(name)
+        return entry
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)
+
+    # ------------------------------------------------------------ reload
+    def reload(self, name: str) -> Entry:
+        """Force a re-read of ``name``'s kernel file (new version)."""
+        entry = self.get(name)
+        if entry.path is None:
+            raise RegistryError(
+                f"kernel {name!r} was registered from memory; "
+                "nothing to reload")
+        new = self.load(name, entry.path, model=entry.model)
+        obs.count("serve.reload", kernel=name, version=new.version)
+        return new
+
+    def maybe_reload(self, name: str) -> bool:
+        """Hot-reload ``name`` if its file's mtime changed since the
+        last (re)load.  Returns True when a new version was installed.
+        A vanished or unreadable file keeps the resident version (a
+        serving process must not drop a kernel over a torn overwrite);
+        the failed probe is counted, not raised."""
+        entry = self.get(name)
+        if entry.path is None:
+            return False
+        try:
+            mtime = os.stat(entry.path).st_mtime
+        except OSError:
+            obs.count("serve.reload_failed", kernel=name, reason="stat")
+            return False
+        if entry.mtime is not None and mtime == entry.mtime:
+            return False
+        try:
+            self.load(name, entry.path, model=entry.model)
+        except Exception:
+            obs.count("serve.reload_failed", kernel=name, reason="load")
+            return False
+        obs.count("serve.reload", kernel=name,
+                  version=self.get(name).version)
+        return True

@@ -1,0 +1,256 @@
+"""Serving front ends: the in-process ``Session`` and the HTTP shim.
+
+The reference's deployment story is *embedding* — a host scientific
+code calls ``_NN(run,kernel)`` in its inner loop.  :class:`Session` is
+that story kept resident: load kernels once, then ``infer(name, x)``
+from any number of threads; requests coalesce through one
+:class:`~hpnn_tpu.serve.batcher.Batcher` per kernel into bucketed
+compiled forwards (:class:`~hpnn_tpu.serve.engine.Engine`).
+
+The HTTP layer is deliberately thin — stdlib ``http.server`` over the
+same Session, for drivers that aren't Python:
+
+* ``POST /v1/infer``  ``{"kernel": n, "inputs": [...]}`` →
+  ``{"outputs": [...]}``; 404 unknown kernel, 400 malformed,
+  **429** queue full (retriable, ``Retry-After`` set), **504**
+  deadline exceeded (retriable).
+* ``POST /v1/reload`` ``{"kernel": n}`` → re-read the kernel file.
+* ``GET /healthz`` → kernel census.
+
+Nothing here writes stdout (request logging is suppressed; errors go
+to stderr) — the token protocol stays byte-frozen even when a server
+runs inside a driver process.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from hpnn_tpu import obs
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull
+from hpnn_tpu.serve.engine import (DEFAULT_MAX_BATCH, DEFAULT_N_BUCKETS,
+                                   Engine)
+from hpnn_tpu.serve.registry import Registry, RegistryError
+
+
+class Session:
+    """Resident inference session: registry + engine + per-kernel
+    micro-batchers behind one ``infer`` call.
+
+    ``start=False`` runs with no drain threads (tests step batchers
+    by hand via ``batcher_for(name).drain_once()``); ``clock`` is
+    forwarded to the batchers for fake-clock tests.
+    """
+
+    def __init__(self, *, max_batch: int = DEFAULT_MAX_BATCH,
+                 n_buckets: int = DEFAULT_N_BUCKETS,
+                 max_wait_ms: float = 2.0, max_depth: int = 256,
+                 clock=time.monotonic, start: bool = True,
+                 mode: str | None = None):
+        self.registry = Registry()
+        self.engine = Engine(self.registry, max_batch=max_batch,
+                             n_buckets=n_buckets, mode=mode)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_depth = int(max_depth)
+        self._clock = clock
+        self._start = bool(start)
+        self._lock = threading.Lock()
+        self._batchers: dict[str, Batcher] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------ kernels
+    def load_kernel(self, name: str, path: str, *,
+                    model: str = "ann", warmup: bool = True):
+        """Load a kernel file, install it, optionally pre-compile the
+        whole bucket menu so serving never hits a compile stall."""
+        entry = self.registry.load(name, path, model=model)
+        if warmup:
+            self.engine.warmup([name])
+        return entry
+
+    def register_kernel(self, name: str, kernel: kernel_mod.Kernel, *,
+                        model: str = "ann", warmup: bool = True):
+        """Install in-memory weights (no file backing, no hot-reload)."""
+        entry = self.registry.register(name, kernel, model=model)
+        if warmup:
+            self.engine.warmup([name])
+        return entry
+
+    def reload(self, name: str, *, warmup: bool = True):
+        """Force a re-read of ``name``'s kernel file and re-warm it."""
+        entry = self.registry.reload(name)
+        if warmup:
+            self.engine.warmup([name])
+        self.engine.evict(name, keep_version=entry.version)
+        return entry
+
+    def maybe_reload(self, name: str) -> bool:
+        """Hot-reload ``name`` if its file changed on disk."""
+        if not self.registry.maybe_reload(name):
+            return False
+        entry = self.registry.get(name)
+        self.engine.warmup([name])
+        self.engine.evict(name, keep_version=entry.version)
+        return True
+
+    def kernels(self) -> list[str]:
+        return self.registry.names()
+
+    # ------------------------------------------------------------ infer
+    def batcher_for(self, name: str) -> Batcher:
+        self.registry.get(name)  # KeyError for unknown kernels
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("session is closed")
+            b = self._batchers.get(name)
+            if b is None:
+                b = Batcher(
+                    lambda payloads, _n=name: self.engine.dispatch(
+                        _n, payloads),
+                    max_batch=self.engine.max_batch,
+                    max_wait_ms=self.max_wait_ms,
+                    max_depth=self.max_depth,
+                    clock=self._clock, name=name, start=self._start)
+                self._batchers[name] = b
+        return b
+
+    def infer(self, name: str, x, *, timeout_s: float = 5.0):
+        """Forward ``x`` through kernel ``name`` via the micro-batcher.
+
+        ``x`` may be one input vector ``(n_in,)`` → returns
+        ``(n_out,)``, or a row block ``(R, n_in)`` → returns
+        ``(R, n_out)``.  Raises :class:`KeyError` (unknown kernel),
+        :class:`QueueFull` / :class:`DeadlineExceeded` (retriable).
+        """
+        arr = np.asarray(x)
+        single = arr.ndim == 1
+        rows = np.atleast_2d(arr)
+        batcher = self.batcher_for(name)
+        with obs.timer("serve.request", kernel=name,
+                       rows=rows.shape[0]):
+            out = batcher.infer(rows, rows=rows.shape[0],
+                                timeout_s=timeout_s)
+        return out[0] if single else out
+
+    # ------------------------------------------------------------ close
+    def close(self):
+        with self._lock:
+            self._closed = True
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "hpnn-serve/0.1"
+
+    # stdout is the token protocol's — request logs go to stderr
+    def log_message(self, fmt, *args):
+        sys.stderr.write("serve: %s - %s\n"
+                         % (self.address_string(), fmt % args))
+
+    @property
+    def session(self) -> Session:
+        return self.server.session  # type: ignore[attr-defined]
+
+    def _reply(self, code: int, payload: dict,
+               headers: dict | None = None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._reply(200, {"status": "ok",
+                              "kernels": self.session.kernels(),
+                              "buckets": list(
+                                  self.session.engine.buckets)})
+        else:
+            self._reply(404, {"error": f"no such path {self.path}"})
+
+    def _read_json(self) -> dict | None:
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            obj = json.loads(self.rfile.read(n) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return None
+        return obj if isinstance(obj, dict) else None
+
+    def do_POST(self):
+        req = self._read_json()
+        if req is None:
+            self._reply(400, {"error": "malformed JSON body"})
+            return
+        if self.path == "/v1/infer":
+            self._infer(req)
+        elif self.path == "/v1/reload":
+            self._reload(req)
+        else:
+            self._reply(404, {"error": f"no such path {self.path}"})
+
+    def _infer(self, req: dict):
+        name = req.get("kernel", "default")
+        try:
+            inputs = np.asarray(req.get("inputs"), dtype=np.float64)
+        except (TypeError, ValueError):
+            self._reply(400, {"error": "inputs must be numeric"})
+            return
+        if inputs.ndim not in (1, 2):
+            self._reply(400, {"error": "inputs must be a vector or a "
+                                       "list of vectors"})
+            return
+        timeout_s = float(req.get("timeout_s", 5.0))
+        try:
+            out = self.session.infer(name, inputs, timeout_s=timeout_s)
+        except KeyError:
+            self._reply(404, {"error": f"unknown kernel {name!r}"})
+        except QueueFull as exc:
+            self._reply(429, {"error": str(exc), "retriable": True},
+                        headers={"Retry-After": "1"})
+        except DeadlineExceeded as exc:
+            self._reply(504, {"error": str(exc), "retriable": True})
+        except ValueError as exc:
+            self._reply(400, {"error": str(exc)})
+        else:
+            self._reply(200, {"kernel": name,
+                              "outputs": np.asarray(out).tolist()})
+
+    def _reload(self, req: dict):
+        name = req.get("kernel", "default")
+        try:
+            entry = self.session.reload(name)
+        except KeyError:
+            self._reply(404, {"error": f"unknown kernel {name!r}"})
+        except RegistryError as exc:
+            self._reply(400, {"error": str(exc)})
+        else:
+            self._reply(200, {"kernel": name,
+                              "version": entry.version})
+
+
+def make_server(session: Session, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bind the HTTP front end over ``session`` (port 0 = ephemeral;
+    read ``server.server_address`` for the bound port).  Call
+    ``serve_forever()`` — typically on a thread — and ``shutdown()``
+    to stop."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    server.daemon_threads = True
+    server.session = session  # type: ignore[attr-defined]
+    obs.event("serve.listen", host=host,
+              port=server.server_address[1])
+    return server

@@ -33,9 +33,16 @@ _FLOAT = re.compile(r"[-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?")
 
 # strtod at the START of the remainder (the reference's GET_DOUBLE
 # chain walks the line; a stray word between numbers fails the row,
-# unlike a find-anywhere regex)
+# unlike a find-anywhere regex).  Covers strtod's full grammar: the
+# decimal forms plus the case-insensitive INF/INFINITY and
+# NAN/NAN(n-char-seq) forms — an ATOM row carrying "nan" occupancy is
+# a valid strtod parse (the reference would accept it), so it must
+# consume here rather than fail the whole file.
 _LEAD_FLOAT = re.compile(
-    r"[ \t\n\r\f\v]*([-+]?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?)")
+    r"[ \t\n\r\f\v]*([-+]?(?:"
+    r"\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?"
+    r"|[-+]?(?:[iI][nN][fF](?:[iI][nN][iI][tT][yY])?"
+    r"|[nN][aA][nN](?:\([0-9A-Za-z_]*\))?))")
 
 # The 119-symbol element table of the reference's atom.def
 # (crystallographic constants; ref: tutorials/ann/atom.def:3).  Index

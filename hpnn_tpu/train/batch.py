@@ -281,6 +281,31 @@ def accuracy_counts(out: np.ndarray, T: np.ndarray, model: str) -> int:
     return int(_count_correct(np, out, T, model))
 
 
+def fused_vmem_bytes(weights, B: int, *, momentum: bool,
+                     use_bank: bool) -> int:
+    """f32 VMEM footprint of one fused Pallas batch step at block size
+    ``B`` — the gate that decides whether the kernel may run under the
+    12 MiB budget.  Counts the resident block (X + T), the acts+deltas
+    scratch (2·B·Σ out_l), the weights (aliased in-place, once; twice
+    with momentum), and — on the banked grid-epoch kernel — the
+    double-buffered NEXT block of X and T that the grid pipeline keeps
+    in flight while the current block computes.  Underestimating that
+    last term let near-limit shapes pass the gate and then demote
+    silently at Mosaic compile time."""
+    n_outs = sum(int(w.shape[0]) for w in weights)
+    n_in = int(weights[0].shape[1])
+    n_out = int(weights[-1].shape[0])
+    n_w = sum(int(np.asarray(w).size) for w in weights)
+    vmem = 4 * (
+        B * (n_in + n_out)                      # X + T
+        + 2 * B * n_outs                        # acts + deltas scratch
+        + n_w * (2 if momentum else 1)
+    )
+    if use_bank:
+        vmem += 4 * B * (n_in + n_out)          # next block, in flight
+    return vmem
+
+
 def _batch_state_key(sample_dir, model, momentum, shapes, B, lr, epochs,
                      init_key="", names=None):
     """Round identity for batch-mode crash-resume checkpoints: the
@@ -336,10 +361,19 @@ def train_kernel_batched(
     )
     from hpnn_tpu.parallel import dist
 
-    if not dist.census_consistent(all_files if have_dir else ["\x00missing"]):
+    # the census hashes the raw listing PLUS the readable-sample count:
+    # a rank that lists the same files but fails to read some (torn
+    # write, permission skew) would otherwise build a differently-sized
+    # bank and diverge far downstream in the sharded batch math.  The
+    # \x00 marker can't collide with a real filename (readdir never
+    # returns NUL) — same trick as the missing-dir marker.
+    census = (all_files + ["\x00readable=%d" % len(names)]
+              if have_dir else ["\x00missing"])
+    if not dist.census_consistent(census):
         log.nn_error(
             sys.stderr,
-            "sample dir %s differs across processes (count or order)!\n",
+            "sample dir %s differs across processes "
+            "(count, order, or readable set)!\n",
             conf.samples,
         )
         return False
@@ -414,16 +448,10 @@ def train_kernel_batched(
     # step stays the SNN default.  HPNN_PALLAS=1 forces the kernel
     # on, =0 forces the scan.  Kernel parity itself is proven in
     # tests/test_pallas.py (interpret mode, where reductions agree).
-    # VMEM gate: batch X/T, acts+deltas scratch (2·B·Σout_l), weights
-    # (aliased in-place, counted once)
-    n_outs = sum(int(w.shape[0]) for w in weights)
-    n_in = int(weights[0].shape[1])
-    n_w = sum(int(np.asarray(w).size) for w in weights)
-    vmem_bytes = 4 * (
-        B * (n_in + int(weights[-1].shape[0]))  # X + T
-        + 2 * B * n_outs                        # acts + deltas scratch
-        + n_w * (2 if momentum else 1)
-    )
+    # VMEM gate (fused_vmem_bytes): batch X/T, acts+deltas scratch,
+    # weights, plus the banked kernel's double-buffered next block
+    vmem_bytes = fused_vmem_bytes(
+        weights, B, momentum=momentum, use_bank=use_bank)
     pallas_env = os.environ.get("HPNN_PALLAS", "")
     use_pallas = (
         gather
@@ -859,10 +887,16 @@ def run_kernel_batched(conf: NNConf) -> None:
     )
     from hpnn_tpu.parallel import dist
 
-    if not dist.census_consistent(all_files if have_dir else ["\x00missing"]):
+    # raw listing + readable count, as in train_kernel_batched: ranks
+    # agreeing on the listing but not on what they could READ must
+    # fail here, not in the sharded eval math
+    census = (all_files + ["\x00readable=%d" % len(names)]
+              if have_dir else ["\x00missing"])
+    if not dist.census_consistent(census):
         log.nn_error(
             sys.stderr,
-            "test dir %s differs across processes (count or order)!\n",
+            "test dir %s differs across processes "
+            "(count, order, or readable set)!\n",
             conf.tests,
         )
         return

@@ -339,14 +339,21 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                     weights, stats = train_epoch(weights, dw0, Xc, Tc)
                     stats = tuple(np.asarray(s) for s in stats)
             except Exception as exc:
-                if use_pallas_epoch and "UNAVAILABLE" not in str(exc):
+                if (chunk_i == 0 and use_pallas_epoch
+                        and "UNAVAILABLE" not in str(exc)):
                     # Mosaic refused the fused-epoch kernel (the
                     # _pallas_hw_ok heuristic is not a compiler): fall
                     # back to the lax body, re-key the checkpoint to
                     # the body actually running from here on, and
                     # retry the same chunk — same discipline as
-                    # batch.py's fused-kernel fallback.  UNAVAILABLE =
-                    # worker crash, not a compile problem.
+                    # batch.py's fused-kernel fallback (block_i == 0).
+                    # A compile refusal can only surface at the FIRST
+                    # dispatch of this process (later chunks reuse the
+                    # compiled executable), so a transient error mid-
+                    # round must propagate to the crash handler below
+                    # rather than silently demoting the body and
+                    # re-keying the checkpoint.  UNAVAILABLE = worker
+                    # crash, not a compile problem.
                     log.nn_warn(
                         sys.stderr,
                         "fused epoch kernel failed (%s); "

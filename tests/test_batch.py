@@ -533,3 +533,53 @@ def test_fast_count_env_gates_precision(monkeypatch):
     args = (weights, jnp.asarray(X), jnp.asarray(T))
     assert "HIGHEST" in str(jax.make_jaxpr(pinned)(*args))
     assert "HIGHEST" not in str(jax.make_jaxpr(fast)(*args))
+
+
+def test_census_carries_readable_count(tmp_path, monkeypatch):
+    """The multi-process census hashes the raw listing PLUS a readable
+    count marker: ranks agreeing on the listing but not on what they
+    could READ (torn write, permission skew) must disagree at the
+    census, not diverge in the sharded batch math downstream."""
+    from hpnn_tpu.parallel import dist
+
+    conf = _conf(tmp_path, n=6)
+    # one listed-but-unreadable sample
+    (tmp_path / "samples" / "s00099.txt").write_text("[input] zero\n")
+    seen = {}
+    real = dist.census_consistent
+
+    def spy(names):
+        seen["census"] = list(names)
+        return real(names)
+
+    monkeypatch.setattr(dist, "census_consistent", spy)
+    assert batch_mod.train_kernel_batched(conf, batch_size=4, epochs=1)
+    census = seen["census"]
+    assert len(census) == 8                  # 7 listed files + marker
+    assert census[-1] == "\x00readable=6"    # 6 of 7 actually read
+    assert all("\x00" not in n for n in census[:-1])
+
+    # the eval census carries the same marker
+    seen.clear()
+    batch_mod.run_kernel_batched(conf)
+    assert seen["census"][-1] == "\x00readable=6"
+
+
+def test_fused_vmem_bytes_banked_double_buffer_term():
+    """The VMEM gate must count the banked grid kernel's in-flight NEXT
+    block (4·B·(n_in+n_out)): underestimating it let near-limit shapes
+    pass the gate and then demote silently at Mosaic compile time."""
+    k, _ = kernel_mod.generate(1, 8, [6], 2)
+    w = [np.asarray(a, dtype=np.float32) for a in k.weights]
+    B = 128
+    n_in, n_out, n_outs = 8, 2, 6 + 2
+    n_w = 6 * 8 + 2 * 6
+    base = batch_mod.fused_vmem_bytes(w, B, momentum=False,
+                                      use_bank=False)
+    assert base == 4 * (B * (n_in + n_out) + 2 * B * n_outs + n_w)
+    banked = batch_mod.fused_vmem_bytes(w, B, momentum=False,
+                                        use_bank=True)
+    assert banked - base == 4 * B * (n_in + n_out)
+    mom = batch_mod.fused_vmem_bytes(w, B, momentum=True,
+                                     use_bank=False)
+    assert mom - base == 4 * n_w  # momentum doubles the weight term

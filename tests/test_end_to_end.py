@@ -606,3 +606,37 @@ def test_fused_round_body_binds_checkpoint_key(workdir, capsys, monkeypatch):
     # only the remaining samples were trained by the resume
     assert len([ln for ln in out.splitlines() if "TRAINING FILE" in ln]) == 12
     assert not state.exists()
+
+
+def test_fused_round_midround_failure_propagates(workdir, capsys,
+                                                 monkeypatch):
+    """The Mosaic-refusal fallback is gated to the FIRST dispatch
+    (chunk_i == 0, same discipline as batch.py's block_i == 0): a
+    compile refusal can only surface there — later chunks reuse the
+    compiled executable — so a non-UNAVAILABLE error on a LATER chunk
+    is a transient fault that must propagate to the crash handler,
+    not silently demote the body and re-key the checkpoint."""
+    from hpnn_tpu import config
+    from hpnn_tpu.ops import pallas_train
+    from hpnn_tpu.train import driver, loop
+    from hpnn_tpu.utils import logging as log
+
+    log.set_verbose(2)
+    conf_path = _conf(workdir)
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "8")
+    monkeypatch.setattr(loop, "_pallas_epoch_default", lambda w: True)
+    calls = {"n": 0}
+
+    def flaky_fused(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise ValueError("transient device fault (simulated)")
+        return loop.train_epoch_lax(*a, **kw)
+
+    monkeypatch.setattr(pallas_train, "train_epoch_fused", flaky_fused)
+    conf = config.load_conf(conf_path)
+    with pytest.raises(ValueError, match="transient device fault"):
+        driver.train_kernel(conf)
+    captured = capsys.readouterr()
+    assert "falling back to the lax body" not in captured.err
+    assert calls["n"] == 2  # chunk 1 trained, chunk 2 raised

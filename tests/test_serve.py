@@ -1,0 +1,539 @@
+"""Resident serving subsystem (hpnn_tpu/serve/, docs/serving.md).
+
+Acceptance bar (ISSUE): a CPU Session serves 64 concurrent mixed-size
+requests through the bucket menu with exactly one compile per
+(kernel, bucket) after warmup — proven via the obs ``serve.compile``
+counter — and every served output is **bitwise-equal** to a direct
+``models.ann.forward`` of the same rows.  Batcher semantics
+(coalescing / deadlines / backpressure) are asserted with a fake
+clock and the public ``drain_once`` — no sleeps.
+"""
+
+import http.client
+import json
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import serve
+from hpnn_tpu.models import ann, kernel as kernel_mod, snn
+from hpnn_tpu.serve import batcher as batcher_mod, engine as engine_mod
+from hpnn_tpu.serve.registry import Registry, RegistryError
+
+
+def _kernel(seed=7, n_in=8, hiddens=(5,), n_out=2):
+    k, _ = kernel_mod.generate(seed, n_in, list(hiddens), n_out)
+    return k
+
+
+def _direct_ann(kernel, rows):
+    """Reference outputs: the per-sample forward, row by row."""
+    return np.stack([np.asarray(ann.run(kernel.weights, x))
+                     for x in np.atleast_2d(rows)])
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------- import
+def test_serve_import_is_jax_free():
+    """import hpnn_tpu.serve must not drag jax in (obs discipline);
+    asserted in a subprocess so this file's own jax use can't mask it."""
+    import subprocess
+
+    code = ("import sys; import hpnn_tpu.serve; "
+            "sys.exit(1 if 'jax' in sys.modules else 0)")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          cwd="/root/repo", capture_output=True)
+    assert proc.returncode == 0, proc.stderr.decode()
+
+
+# ------------------------------------------------------------- registry
+def test_registry_register_validate_and_versions():
+    reg = Registry()
+    e0 = reg.register("k", _kernel())
+    assert (e0.version, e0.model, e0.path) == (0, "ann", None)
+    assert e0.n_inputs == 8 and e0.n_outputs == 2
+    e1 = reg.register("k", _kernel(seed=8))
+    assert e1.version == 1           # replace bumps the version
+    assert reg.get("k") is e1
+    assert reg.names() == ["k"]
+    with pytest.raises(KeyError):
+        reg.get("nope")
+    # a broken layer chain must never become resident
+    bad = kernel_mod.Kernel((np.zeros((5, 8)), np.zeros((2, 6))))
+    with pytest.raises(RegistryError):
+        reg.register("bad", bad)
+    with pytest.raises(RegistryError):
+        reg.register("k", _kernel(), model="cnn")
+
+
+def test_registry_load_and_hot_reload(tmp_path):
+    import os
+
+    path = tmp_path / "kernel.opt"
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=1), fp)
+    reg = Registry()
+    e0 = reg.load("k", str(path))
+    assert e0.version == 0 and e0.path == str(path)
+    # same mtime → no reload
+    assert reg.maybe_reload("k") is False
+    assert reg.get("k").version == 0
+    # overwrite with new weights, force a new mtime
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=2), fp)
+    os.utime(path, (e0.mtime + 10, e0.mtime + 10))
+    assert reg.maybe_reload("k") is True
+    e1 = reg.get("k")
+    assert e1.version == 1
+    assert not np.array_equal(np.asarray(e1.kernel.weights[0]),
+                              np.asarray(e0.kernel.weights[0]))
+    # a torn overwrite keeps the resident version (counted, not raised)
+    path.write_text("[name] broken\n")
+    os.utime(path, (e0.mtime + 20, e0.mtime + 20))
+    assert reg.maybe_reload("k") is False
+    assert reg.get("k") is e1
+    # vanished file: same — serving must not drop the kernel
+    path.unlink()
+    assert reg.maybe_reload("k") is False
+    assert reg.get("k") is e1
+    # memory-registered kernels have no reload source
+    reg.register("m", _kernel())
+    assert reg.maybe_reload("m") is False
+    with pytest.raises(RegistryError):
+        reg.reload("m")
+
+
+# -------------------------------------------------------------- batcher
+def test_batcher_coalesces_within_max_batch():
+    clock = FakeClock()
+    batches = []
+    b = batcher_mod.Batcher(lambda p: batches.append(p) or list(p),
+                            max_batch=16, clock=clock, start=False)
+    reqs = [b.submit(i, rows=2) for i in range(3)]
+    assert b.drain_once() == 3       # all three in ONE dispatch
+    assert batches == [[0, 1, 2]]
+    assert [b.result(r, timeout_s=0) for r in reqs] == [0, 1, 2]
+    assert b.depth() == 0
+
+
+def test_batcher_splits_on_row_budget():
+    clock = FakeClock()
+    batches = []
+    b = batcher_mod.Batcher(lambda p: batches.append(p) or list(p),
+                            max_batch=16, clock=clock, start=False)
+    b.submit("a", rows=10)
+    b.submit("b", rows=10)           # 20 rows > max_batch: next batch
+    b.submit("c", rows=6)
+    assert b.drain_once() == 1       # "a" alone (b would overflow)
+    assert b.drain_once() == 2       # "b" + "c" = 16 rows exactly
+    assert batches == [["a"], ["b", "c"]]
+    # an oversized single request still dispatches (engine chunks it)
+    b.submit("huge", rows=40)
+    assert b.drain_once() == 1
+    assert batches[-1] == ["huge"]
+
+
+def test_batcher_deadline_expires_in_queue():
+    clock = FakeClock()
+    served = []
+    b = batcher_mod.Batcher(lambda p: served.extend(p) or list(p),
+                            max_batch=16, clock=clock, start=False)
+    dead = b.submit("late", timeout_s=1.0)
+    clock.advance(2.0)
+    live = b.submit("fresh", timeout_s=5.0)
+    assert b.drain_once() == 1       # only the live request dispatched
+    assert served == ["fresh"]
+    assert b.result(live, timeout_s=0) == "fresh"
+    with pytest.raises(batcher_mod.DeadlineExceeded) as ei:
+        b.result(dead, timeout_s=0)
+    assert ei.value.retriable is True
+
+
+def test_batcher_backpressure_queue_full():
+    clock = FakeClock()
+    b = batcher_mod.Batcher(lambda p: list(p), max_batch=4,
+                            max_depth=2, clock=clock, start=False)
+    b.submit("a")
+    b.submit("b")
+    with pytest.raises(batcher_mod.QueueFull) as ei:
+        b.submit("c")
+    assert ei.value.retriable is True
+    assert b.drain_once() == 2       # draining frees the queue again
+    b.submit("c")
+
+
+def test_batcher_dispatch_error_fails_whole_batch():
+    clock = FakeClock()
+
+    def boom(payloads):
+        raise RuntimeError("device fell over")
+
+    b = batcher_mod.Batcher(boom, max_batch=16, clock=clock, start=False)
+    r1, r2 = b.submit("a"), b.submit("b")
+    assert b.drain_once() == 2
+    for r in (r1, r2):
+        with pytest.raises(RuntimeError, match="device fell over"):
+            b.result(r, timeout_s=0)
+
+
+def test_batcher_close_fails_parked_requests():
+    clock = FakeClock()
+    b = batcher_mod.Batcher(lambda p: list(p), clock=clock, start=False)
+    r = b.submit("parked")
+    b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.result(r, timeout_s=0)
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit("x")
+
+
+# --------------------------------------------------------------- engine
+def test_bucket_menu_and_bucket_for():
+    assert engine_mod.bucket_menu(64, 4) == (8, 16, 32, 64)
+    assert engine_mod.bucket_menu(48, 4) == (8, 16, 32, 64)  # round up
+    assert engine_mod.bucket_menu(16, 3) == (4, 8, 16)
+    assert engine_mod.bucket_menu(1, 4) == (1,)   # stops at bucket 1
+    with pytest.raises(ValueError):
+        engine_mod.bucket_menu(0)
+    menu = (8, 16, 32, 64)
+    assert engine_mod.bucket_for(menu, 1) == 8
+    assert engine_mod.bucket_for(menu, 8) == 8
+    assert engine_mod.bucket_for(menu, 9) == 16
+    assert engine_mod.bucket_for(menu, 64) == 64
+    assert engine_mod.bucket_for(menu, 200) == 64  # caller chunks
+
+
+@pytest.mark.parametrize("rows", [1, 3, 8, 11, 16, 40])
+def test_engine_padded_outputs_bitwise_equal_direct_forward(rows):
+    """The acceptance numerics: padding/chunking through the bucket
+    menu must not perturb a single bit vs the per-sample forward —
+    rows=11 pads into the 16 bucket, rows=40 chunks through the top
+    bucket twice."""
+    k = _kernel(seed=3)
+    reg = Registry()
+    entry = reg.register("k", k)
+    eng = engine_mod.Engine(reg, max_batch=16, n_buckets=3)
+    rng = np.random.RandomState(rows)
+    X = rng.uniform(-1.0, 1.0, size=(rows, 8))
+    out = eng.run_rows(entry, X)
+    want = _direct_ann(k, X)
+    assert out.dtype == want.dtype == np.float64
+    assert np.array_equal(out, want)  # bitwise, not allclose
+
+
+def test_engine_snn_outputs_bitwise_equal_direct_forward():
+    k = _kernel(seed=5)
+    reg = Registry()
+    entry = reg.register("k", k, model="snn")
+    eng = engine_mod.Engine(reg, max_batch=8, n_buckets=2)
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1.0, 1.0, size=(5, 8))
+    out = eng.run_rows(entry, X)
+    want = np.stack([np.asarray(snn.run(k.weights, x)) for x in X])
+    assert np.array_equal(out, want)
+
+
+def test_engine_warmup_compiles_menu_once():
+    reg = Registry()
+    reg.register("k", _kernel())
+    eng = engine_mod.Engine(reg, max_batch=16, n_buckets=3)
+    assert eng.compiled_count() == 0
+    assert eng.warmup() == 3
+    assert eng.compiled_count() == 3
+    eng.warmup()                      # idempotent: cache hits only
+    assert eng.compiled_count() == 3
+
+
+def test_engine_compiled_mode_aot_executables():
+    """The compiled mode (TPU/GPU default) is CPU-testable: real AOT
+    executables per bucket, padded dispatch, ulp-level agreement with
+    the per-sample path (bitwise is parity mode's contract — XLA does
+    not promise codegen-stable numerics across program shapes)."""
+    k = _kernel(seed=3)
+    reg = Registry()
+    entry = reg.register("k", k)
+    eng = engine_mod.Engine(reg, max_batch=16, n_buckets=3,
+                            mode="compiled")
+    assert eng.mode == "compiled"
+    assert eng.warmup() == 3
+    rng = np.random.RandomState(2)
+    X = rng.uniform(-1, 1, size=(11, 8))
+    out = eng.run_rows(entry, X)      # pads into the 16 bucket
+    np.testing.assert_allclose(out, _direct_ann(k, X),
+                               rtol=0, atol=1e-12)
+    assert eng.compiled_count() == 3  # dispatch compiled nothing new
+
+
+def test_engine_mode_selection(monkeypatch):
+    monkeypatch.setenv("HPNN_SERVE_MODE", "compiled")
+    eng = engine_mod.Engine(Registry(), max_batch=8, n_buckets=2)
+    assert eng.mode == "compiled"
+    monkeypatch.delenv("HPNN_SERVE_MODE")
+    eng2 = engine_mod.Engine(Registry(), max_batch=8, n_buckets=2)
+    assert eng2.mode == "parity"      # CPU backend default
+    with pytest.raises(ValueError, match="serve mode"):
+        engine_mod.Engine(Registry(), mode="jitted")
+
+
+def test_engine_dispatch_splits_results_per_payload():
+    k = _kernel()
+    reg = Registry()
+    reg.register("k", k)
+    eng = engine_mod.Engine(reg, max_batch=16, n_buckets=3)
+    rng = np.random.RandomState(1)
+    blocks = [rng.uniform(-1, 1, size=(r, 8)) for r in (1, 3, 2)]
+    outs = eng.dispatch("k", blocks)
+    assert [o.shape for o in outs] == [(1, 2), (3, 2), (2, 2)]
+    for blk, out in zip(blocks, outs):
+        assert np.array_equal(out, _direct_ann(k, blk))
+    with pytest.raises(ValueError, match="n_inputs"):
+        eng.dispatch("k", [np.zeros((2, 5))])
+
+
+def test_engine_evict_keeps_requested_version():
+    reg = Registry()
+    reg.register("k", _kernel(seed=1))
+    eng = engine_mod.Engine(reg, max_batch=8, n_buckets=2)
+    eng.warmup()
+    reg.register("k", _kernel(seed=2))   # version 1
+    eng.warmup()
+    assert eng.compiled_count() == 4     # both versions resident
+    eng.evict("k", keep_version=1)
+    assert eng.compiled_count() == 2
+
+
+# -------------------------------------------------- session acceptance
+def _read_sink(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def test_session_64_concurrent_requests_one_compile_per_bucket(tmp_path):
+    """THE acceptance test: 64 concurrent mixed-size requests through
+    ≤4 buckets, exactly one compile per (kernel, bucket) after warmup
+    (obs serve.compile counter), outputs bitwise-equal to direct
+    ann.forward."""
+    from hpnn_tpu import obs
+
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        k = _kernel(seed=9)
+        sess = serve.Session(max_batch=64, n_buckets=4, max_wait_ms=2.0)
+        sess.register_kernel("k", k)          # warmup inside
+        assert list(sess.engine.buckets) == [8, 16, 32, 64]
+        n_buckets = len(sess.engine.buckets)
+        assert sess.engine.compiled_count() == n_buckets
+
+        rng = np.random.RandomState(42)
+        inputs = [rng.uniform(-1.0, 1.0, size=((i % 8) + 1, 8))
+                  for i in range(64)]
+        outs: list = [None] * 64
+        errs: list = []
+
+        def client(i):
+            try:
+                outs[i] = sess.infer("k", inputs[i], timeout_s=30.0)
+            except Exception as exc:  # collected, asserted empty below
+                errs.append((i, repr(exc)))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(64)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        for x, out in zip(inputs, outs):
+            assert np.array_equal(out, _direct_ann(k, x))
+        # steady state: serving compiled NOTHING beyond the menu
+        assert sess.engine.compiled_count() == n_buckets
+        sess.close()
+    finally:
+        obs.configure(None)
+
+    recs = _read_sink(sink)
+    compiles = [r for r in recs if r["ev"] == "serve.compile"]
+    assert len(compiles) == n_buckets
+    assert sorted(r["bucket"] for r in compiles) == [8, 16, 32, 64]
+    assert all(r["kind"] == "count" for r in compiles)
+
+
+def test_session_single_vector_and_unknown_kernel():
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    k = _kernel()
+    sess.register_kernel("k", k)
+    out = sess.infer("k", np.zeros(8))
+    assert out.shape == (2,)
+    assert np.array_equal(out, _direct_ann(k, np.zeros(8))[0])
+    with pytest.raises(KeyError):
+        sess.infer("nope", np.zeros(8))
+    sess.close()
+
+
+def test_session_hot_reload_changes_outputs(tmp_path):
+    import os
+
+    path = tmp_path / "kernel.opt"
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=1), fp)
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    e0 = sess.load_kernel("k", str(path))
+    x = np.ones(8)
+    out0 = sess.infer("k", x)
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=2), fp)
+    os.utime(path, (e0.mtime + 10, e0.mtime + 10))
+    assert sess.maybe_reload("k") is True
+    assert sess.registry.get("k").version == 1
+    out1 = sess.infer("k", x)
+    assert not np.array_equal(out0, out1)
+    # the old version's executables were evicted: menu-sized cache
+    assert sess.engine.compiled_count() == len(sess.engine.buckets)
+    assert sess.maybe_reload("k") is False   # unchanged mtime
+    sess.close()
+
+
+def test_obs_event_schema(tmp_path):
+    """Every serve.* record carries the obs envelope (ts/ev/kind) and
+    the subsystem emits its catalog events during one served round."""
+    from hpnn_tpu import obs
+
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+        sess.register_kernel("k", _kernel())
+        sess.infer("k", np.zeros((3, 8)))
+        sess.close()
+    finally:
+        obs.configure(None)
+    recs = [r for r in _read_sink(sink) if r["ev"].startswith("serve.")]
+    assert recs
+    for r in recs:
+        assert {"ts", "ev", "kind"} <= set(r)
+        assert r["kind"] in ("event", "count", "gauge", "timer",
+                             "hist", "summary")
+    names = {r["ev"] for r in recs}
+    for want in ("serve.kernel_load", "serve.warmup", "serve.compile",
+                 "serve.compile_time", "serve.queue_depth",
+                 "serve.wait_ms", "serve.batch_size",
+                 "serve.bucket_hit", "serve.forward", "serve.request"):
+        assert want in names, f"missing {want} in {sorted(names)}"
+
+
+# ------------------------------------------------------------ HTTP/CLI
+def test_serve_nn_http_round_trip(workdir_conf, capsys):
+    from hpnn_tpu import config
+    from hpnn_tpu.cli import serve_nn
+
+    conf = config.load_conf(workdir_conf)
+    session, server = serve_nn.build_from_conf(conf, max_batch=8,
+                                               n_buckets=2, port=0)
+    host, port = server.server_address[:2]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        cn = http.client.HTTPConnection(host, port, timeout=10)
+        cn.request("GET", "/healthz")
+        health = json.loads(cn.getresponse().read())
+        assert health["kernels"] == ["E2E"]
+        assert health["buckets"] == [4, 8]
+
+        x = np.linspace(-1, 1, 8)
+        body = json.dumps({"kernel": "E2E", "inputs": x.tolist()})
+        cn.request("POST", "/v1/infer", body=body,
+                   headers={"Content-Type": "application/json"})
+        resp = cn.getresponse()
+        assert resp.status == 200
+        out = np.asarray(json.loads(resp.read())["outputs"])
+        assert np.array_equal(out, _direct_ann(conf.kernel, x)[0])
+
+        def roundtrip(path, body):
+            cn.request("POST", path, body=body)
+            resp = cn.getresponse()
+            resp.read()  # drain: keep-alive needs the body consumed
+            return resp.status
+
+        assert roundtrip(
+            "/v1/infer",
+            json.dumps({"kernel": "nope", "inputs": [0.0]})) == 404
+        assert roundtrip("/v1/infer", b"not json") == 400
+        # memory-registered kernel: reload is a clean client error
+        assert roundtrip(
+            "/v1/reload", json.dumps({"kernel": "E2E"})) == 400
+        cn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        session.close()
+    # the token protocol stays silent: no stdout from serving
+    assert capsys.readouterr().out == ""
+
+
+@pytest.fixture
+def workdir_conf(tmp_path, monkeypatch):
+    """A minimal generate-init conf (no samples needed for serving)."""
+    p = tmp_path / "nn.conf"
+    p.write_text(
+        "[name] E2E\n[type] ANN\n[init] generate\n[seed] 1234\n"
+        "[input] 8\n[hidden] 6\n[output] 2\n[train] BP\n"
+        "[sample_dir] ./samples\n[test_dir] ./samples\n")
+    monkeypatch.chdir(tmp_path)
+    return str(p)
+
+
+def test_build_from_conf_rejects_unservable(workdir_conf):
+    from hpnn_tpu import config
+    from hpnn_tpu.cli import serve_nn
+
+    conf = config.load_conf(workdir_conf)
+    conf.kernel = None
+    with pytest.raises(ValueError, match="no kernel"):
+        serve_nn.build_from_conf(conf)
+
+
+def test_validate_long_opts_serving_knobs(capsys):
+    from hpnn_tpu.cli import common
+
+    assert common.validate_long_opts({"port": "8700"}) is True
+    assert common.validate_long_opts({"port": "70000"}) is False
+    assert "bad --port" in capsys.readouterr().err
+    assert common.validate_long_opts({"port": "nope"}) is False
+    assert common.validate_long_opts({"max-batch": "16"}) is True
+    assert common.validate_long_opts({"max-batch": "0"}) is False
+    assert common.validate_long_opts({"max-wait-ms": "2.5"}) is True
+    assert common.validate_long_opts({"max-wait-ms": "0"}) is True
+    assert common.validate_long_opts({"max-wait-ms": "-1"}) is False
+    assert common.validate_long_opts({"max-wait-ms": "soon"}) is False
+
+
+def test_bench_serve_smoke_reports_latency_and_compile_census():
+    sys.path.insert(0, "/root/repo/tools")
+    try:
+        import bench_serve
+    finally:
+        sys.path.pop(0)
+    out = bench_serve.run_serve_bench(
+        n_in=8, hiddens=[5], n_out=2, n_clients=4, n_requests=3,
+        max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    assert "errors" not in out, out
+    assert out["requests_served"] == 12
+    assert out["latency_ms"]["p50"] is not None
+    assert out["latency_ms"]["p99"] >= out["latency_ms"]["p50"]
+    assert out["throughput_rps"] > 0
+    # the steady-state invariant, reported by the bench itself
+    assert (out["compiled_after_load"] == out["compiled_after_warmup"]
+            == len(out["buckets"]))

@@ -195,3 +195,31 @@ def test_synth_rruff_pdif_pipeline(tmp_path, capsys):
     for sub in ("dif", "raw"):
         for p in sorted((out / sub).iterdir()):
             assert p.read_bytes() == (out2 / sub / p.name).read_bytes()
+
+
+def test_pdif_lead_float_accepts_strtod_special_forms():
+    """GET_DOUBLE is strtod: inf/infinity/nan/nan(n-char-seq) in any
+    case, with optional sign, are valid parses and must consume."""
+    for s in ("inf", "INF", "-inf", "+Infinity", "iNfInItY",
+              "nan", "NAN", "-nan", "nan(0x7ff)", "NaN(box_1)",
+              " \tnan"):
+        m = pdif._LEAD_FLOAT.match(s)
+        assert m is not None and m.end() == len(s), s
+    # prefixes that are NOT a number still fail...
+    assert pdif._LEAD_FLOAT.match("in") is None
+    assert pdif._LEAD_FLOAT.match("na") is None
+    assert pdif._LEAD_FLOAT.match("bogus") is None
+    # ...and strtod's longest-valid-prefix rule holds
+    m = pdif._LEAD_FLOAT.match("inferior")
+    assert m is not None and m.group(1) == "inf"
+    m = pdif._LEAD_FLOAT.match("nan(abc) rest")
+    assert m is not None and m.group(1) == "nan(abc)"
+
+
+def test_pdif_atom_row_accepts_nan_occupancy():
+    """An ATOM row whose occupancy column reads "nan" (real RRUFF
+    exports do this) is a valid strtod parse — the row must consume
+    as an atom, not FAIL the whole file."""
+    assert pdif._parse_atom_row("O  0.5 0.5 nan 1.0 0.8") == "atom"
+    assert pdif._parse_atom_row("O  0.5 0.5 inf 1.0 0.8") == "atom"
+    assert pdif._parse_atom_row("O  0.5 0.5 bogus 1.0 0.8") == "fail"

@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""Closed-loop load generator for the serving subsystem (docs/serving.md).
+
+N client threads each issue R sequential ``Session.infer`` calls with
+mixed row counts (closed loop: a client's next request starts when its
+previous one returns), against a freshly generated kernel behind the
+full stack — registry → micro-batcher → bucketed engine.  Reports
+per-request latency (p50/p99/mean), request and row throughput, and
+the compile-cache census (the steady-state invariant: executable
+count == bucket count after warmup).
+
+Two presets:
+
+* default — the MNIST tutorial shape (784-300-10), 16 clients ×
+  25 requests: the headline serving figure;
+* ``--smoke`` — a tiny 8-5-2 kernel, 8 × 8 requests: seconds on CPU,
+  wired into ``bench.py``'s detail JSON (``serve_smoke``) and usable
+  as a tier-1 sanity load.
+
+Prints ONE JSON line (the bench.py convention); detail keys only, no
+stdout tokens.  Structured events ride ``HPNN_METRICS`` as usual.
+
+    JAX_PLATFORMS=cpu python tools/bench_serve.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _percentile_ms(lat_s: list[float], q: float) -> float:
+    return round(float(np.percentile(np.asarray(lat_s) * 1e3, q)), 3)
+
+
+def run_serve_bench(
+    *, n_in: int, hiddens: list[int], n_out: int,
+    n_clients: int = 16, n_requests: int = 25,
+    max_batch: int = 64, n_buckets: int = 4, max_wait_ms: float = 2.0,
+    mixed_rows=(1, 2, 4, 8), seed: int = 11, timeout_s: float = 30.0,
+) -> dict:
+    """One closed-loop measurement; returns the result dict."""
+    from hpnn_tpu import serve
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    k, _ = kernel_mod.generate(seed, n_in, hiddens, n_out)
+    session = serve.Session(max_batch=max_batch, n_buckets=n_buckets,
+                            max_wait_ms=max_wait_ms)
+    t0 = time.perf_counter()
+    session.register_kernel("bench", k)          # includes warmup
+    warmup_s = time.perf_counter() - t0
+    compiled_after_warmup = session.engine.compiled_count()
+
+    lats: list[list[float]] = [[] for _ in range(n_clients)]
+    rows_done = [0] * n_clients
+    rejected = [0] * n_clients
+    errors: list[str] = []
+
+    def client(ci: int):
+        rng = np.random.RandomState(1000 + ci)
+        for j in range(n_requests):
+            rows = mixed_rows[(ci + j) % len(mixed_rows)]
+            x = rng.uniform(-1.0, 1.0, size=(rows, n_in))
+            t_req = time.perf_counter()
+            try:
+                session.infer("bench", x, timeout_s=timeout_s)
+            except serve.QueueFull:
+                rejected[ci] += 1
+                continue
+            except Exception as exc:  # a failed load run must say why
+                errors.append(repr(exc))
+                return
+            lats[ci].append(time.perf_counter() - t_req)
+            rows_done[ci] += rows
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    session.close()
+
+    lat = [v for client_l in lats for v in client_l]
+    out = {
+        "metric": "serve_infer_latency",
+        "kernel_shape": f"{n_in}-{'-'.join(map(str, hiddens))}-{n_out}",
+        "n_clients": n_clients,
+        "requests_per_client": n_requests,
+        "requests_served": len(lat),
+        "requests_rejected": int(sum(rejected)),
+        "rows_served": int(sum(rows_done)),
+        "wall_s": round(wall_s, 3),
+        "throughput_rps": round(len(lat) / wall_s, 1) if wall_s else 0.0,
+        "rows_per_s": round(sum(rows_done) / wall_s, 1) if wall_s else 0.0,
+        "latency_ms": {
+            "p50": _percentile_ms(lat, 50) if lat else None,
+            "p99": _percentile_ms(lat, 99) if lat else None,
+            "mean": round(float(np.mean(lat)) * 1e3, 3) if lat else None,
+            "max": round(float(np.max(lat)) * 1e3, 3) if lat else None,
+        },
+        "warmup_s": round(warmup_s, 3),
+        "buckets": list(session.engine.buckets),
+        "compiled_after_warmup": compiled_after_warmup,
+        # the steady-state invariant: serving compiled NOTHING beyond
+        # the warmed menu (one executable per bucket)
+        "compiled_after_load": session.engine.compiled_count(),
+    }
+    if errors:
+        out["errors"] = errors[:5]
+    return out
+
+
+def run_smoke() -> dict:
+    """The tiny preset bench.py folds into its detail JSON."""
+    return run_serve_bench(
+        n_in=8, hiddens=[5], n_out=2, n_clients=8, n_requests=8,
+        max_batch=16, n_buckets=3, max_wait_ms=1.0, seed=7,
+    )
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny 8-5-2 preset (seconds on CPU)")
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=25)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        out = run_smoke()
+    else:
+        out = run_serve_bench(
+            n_in=784, hiddens=[300], n_out=10,
+            n_clients=args.clients, n_requests=args.requests,
+        )
+    print(json.dumps(out))
+    return 1 if out.get("errors") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

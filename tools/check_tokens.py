@@ -120,6 +120,29 @@ def check(tmpdir: str) -> list[str]:
         if '"ev"' in line or '"kind"' in line or line.startswith("{"):
             failures.append(f"obs JSON leaked into stdout: {line!r}")
 
+    # The serving subsystem must be a bystander to the token protocol:
+    # importing it — and actually serving a request through the full
+    # stack (registry → batcher → bucketed engine) — must leave the
+    # next train+eval round's stdout byte-identical.  The session is
+    # exercised BEFORE the round so its jit/compile-cache residue is
+    # live while the round prints.
+    import numpy as np
+
+    from hpnn_tpu import serve
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    sess.register_kernel("lint", k)
+    sess.infer("lint", np.zeros(8))
+    sess.close()
+    with_serve = _run_round(os.path.join(tmpdir, "c"), None)
+    if plain != with_serve:
+        failures.append(
+            "stdout is NOT byte-identical after importing/exercising "
+            f"hpnn_tpu.serve (plain {len(plain)}B vs "
+            f"with-serve {len(with_serve)}B)")
+
     if not os.path.exists(sink):
         failures.append("instrumented run produced no metrics sink")
         return failures
