@@ -484,6 +484,71 @@ def bench_batch():
     return out
 
 
+def bench_obs_overhead(repeats: int = 5):
+    """Paired measurement of the obs subsystem's cost: the SAME tiny
+    fused train round (the check_tokens 6-sample 8->5->2 shape) with
+    ``HPNN_METRICS`` pointed at a fresh sink vs unset, interleaved so
+    each pair shares machine conditions.  Quantifies the design claim
+    that instrumentation is cheap when on and free when off."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.config import NNConf, NNTrain, NNType
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.train import driver
+
+    prev_sink = obs.sink_path() if obs.enabled() else None
+    d = tempfile.mkdtemp(prefix="hpnn_obs_bench_")
+    try:
+        rng = np.random.RandomState(0)
+        sdir = os.path.join(d, "samples")
+        os.makedirs(sdir)
+        for i in range(6):
+            c = i % 2
+            x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
+                + 0.1 * rng.normal(size=8)
+            t = np.full(2, -1.0)
+            t[c] = 1.0
+            with open(os.path.join(sdir, f"s{i:05d}.txt"), "w") as fp:
+                fp.write("[input] 8\n"
+                         + " ".join(f"{v:.5f}" for v in x) + "\n")
+                fp.write("[output] 2\n"
+                         + " ".join(f"{v:.1f}" for v in t) + "\n")
+
+        def conf():
+            k, _ = kernel_mod.generate(7, 8, [5], 2)
+            return NNConf(name="b", type=NNType.ANN, seed=1, kernel=k,
+                          train=NNTrain.BP, samples=sdir, tests=sdir)
+
+        # warm both paths (compile caches, sink open)
+        obs.configure(None)
+        driver.train_kernel(conf())
+        obs.configure(os.path.join(d, "warm.jsonl"))
+        driver.train_kernel(conf())
+
+        on_s, off_s = [], []
+        for r in range(repeats):
+            obs.configure(None)
+            t0 = time.perf_counter()
+            driver.train_kernel(conf())
+            off_s.append(time.perf_counter() - t0)
+            obs.configure(os.path.join(d, f"r{r}.jsonl"))
+            t0 = time.perf_counter()
+            driver.train_kernel(conf())
+            on_s.append(time.perf_counter() - t0)
+        deltas = [round(100.0 * (a - b) / b, 2)
+                  for a, b in zip(on_s, off_s)]
+        return {
+            "round_s_metrics_off": _stats([round(v, 4) for v in off_s]),
+            "round_s_metrics_on": _stats([round(v, 4) for v in on_s]),
+            "paired_overhead_pct": {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            },
+        }
+    finally:
+        obs.configure(prev_sink)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def measure_reference(timeout_s: int = 600):
     """Build the reference serial+OMP and run the SAME 64-sample
     workload with the tutorial's -O4 -B4; returns samples/s or None."""
@@ -574,6 +639,15 @@ def main(argv=None) -> None:
             out["value"] = b["samples_per_s"]["median"]
             out["vs_baseline"] = out["batch_vs_baseline"]
 
+    # obs overhead: the same tiny fused round with metrics on vs off,
+    # paired per repeat — best-effort, and BEFORE the sink fold-in
+    # below (the measurement re-points the sink and then restores it)
+    if not os.environ.get("HPNN_BENCH_NO_OBS_OVERHEAD"):
+        try:
+            out["obs_overhead"] = bench_obs_overhead()
+        except Exception as exc:
+            out["obs_overhead_error"] = repr(exc)
+
     # HPNN_METRICS: the bench subprocesses/rounds inherit the knob, so
     # the run's structured events land in the sink — record where, and
     # fold obs_report's machine summary in (best-effort: a torn sink
@@ -658,6 +732,10 @@ def main(argv=None) -> None:
         compact["serve_p50_ms"] = sm["latency_ms"]["p50"]
         compact["serve_p99_ms"] = sm["latency_ms"]["p99"]
         compact["serve_rps"] = sm["throughput_rps"]
+    if "obs_overhead" in out:
+        compact["obs_overhead_pct"] = (
+            out["obs_overhead"]["paired_overhead_pct"]["median"]
+        )
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:
         compact["obs_metrics_file"] = out["obs_metrics_file"]

@@ -117,11 +117,12 @@ def validate_long_opts(opts: dict) -> bool:
         if not str(v).isdigit() or int(v) < 1:
             sys.stderr.write(f"syntax error: bad --{name} parameter!\n")
             return False
-    port = opts.get("port")
-    if port is not None:
-        if not str(port).isdigit() or int(port) > 65535:
-            sys.stderr.write("syntax error: bad --port parameter!\n")
-            return False
+    for name in ("port", "export-port"):
+        port = opts.get(name)
+        if port is not None:
+            if not str(port).isdigit() or int(port) > 65535:
+                sys.stderr.write(f"syntax error: bad --{name} parameter!\n")
+                return False
     wait = opts.get("max-wait-ms")
     if wait is not None:
         try:

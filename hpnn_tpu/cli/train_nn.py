@@ -18,7 +18,8 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
-        argv, valued=("batch", "epochs", "mesh", "profile", "lr", "metrics")
+        argv, valued=("batch", "epochs", "mesh", "profile", "lr",
+                      "metrics", "export-port")
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
@@ -29,6 +30,33 @@ def main(argv: list[str] | None = None) -> int:
         from hpnn_tpu import obs
 
         obs.configure(opts["metrics"])
+    export_server = None
+    if "export-port" in opts:
+        # live Prometheus scrape endpoint for the whole run; works with
+        # or without --metrics (file-less in-memory aggregation)
+        from hpnn_tpu.obs import export as obs_export
+
+        try:
+            export_server = obs_export.start_export_server(
+                port=int(opts["export-port"]))
+        except OSError as exc:
+            sys.stderr.write(
+                f"train_nn: cannot bind --export-port: {exc}\n")
+            runtime.deinit_all()
+            return -1
+        host, port = export_server.server_address[:2]
+        sys.stderr.write(
+            f"train_nn: metrics export on http://{host}:{port}/metrics\n")
+    try:
+        return _run(argv, opts)
+    finally:
+        if export_server is not None:
+            from hpnn_tpu.obs import export as obs_export
+
+            obs_export.stop_export_server(export_server)
+
+
+def _run(argv: list[str], opts: dict) -> int:
     for needs_batch in ("epochs", "lr"):
         if "batch" not in opts and needs_batch in opts:
             # per-sample mode keeps the reference's fixed learning

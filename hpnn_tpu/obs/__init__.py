@@ -10,7 +10,16 @@ structured side channel next to it:
   (obs/registry.py; lint: tools/check_tokens.py);
 * ``jax.profiler`` named-scope annotations so device profiles
   attribute time to protocol phases (obs/profiler.py);
-* a run-report summarizer over the JSONL (tools/obs_report.py).
+* device telemetry sampled at round/chunk boundaries — HBM occupancy,
+  live-array census, compile-event counters (obs/device.py);
+* live export: the aggregate snapshot rendered as Prometheus text on
+  ``GET /metrics`` (serve server + ``train_nn --export-port``) and a
+  ``/healthz`` process-health document (obs/export.py);
+* a bounded flight recorder dumped atomically on aborts, unhandled
+  exceptions, and SIGTERM/SIGINT — ``HPNN_FLIGHT=<path>``
+  (obs/flight.py);
+* a run-report summarizer over the JSONL, including a ``--merge``
+  cross-rank timeline join (tools/obs_report.py).
 
 Typical instrumentation site::
 
@@ -24,9 +33,11 @@ Typical instrumentation site::
 Event-name catalog and schema: docs/observability.md.
 """
 
+from hpnn_tpu.obs import device, export, flight
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
+    activate_memory,
     configure,
     count,
     enabled,
@@ -35,6 +46,7 @@ from hpnn_tpu.obs.registry import (
     gauge,
     observe,
     sink_path,
+    snapshot_state,
     summary,
     timer,
     _reset_for_tests,
@@ -42,15 +54,20 @@ from hpnn_tpu.obs.registry import (
 
 __all__ = [
     "ENV_KNOB",
+    "activate_memory",
     "annotate",
     "configure",
     "count",
+    "device",
     "enabled",
     "event",
+    "export",
+    "flight",
     "flush",
     "gauge",
     "observe",
     "sink_path",
+    "snapshot_state",
     "step_annotation",
     "summary",
     "timer",

@@ -1,0 +1,118 @@
+"""Device telemetry: HBM occupancy, live-array census, compile counts.
+
+The drivers call :func:`sample` at round and chunk boundaries (never
+inside the per-sample loop), emitting one gauge set per call:
+
+* ``device.hbm_bytes_in_use`` / ``device.hbm_peak_bytes`` — summed
+  ``device.memory_stats()`` over the local devices (TPU/GPU backends;
+  CPU has no allocator stats, so the pair is simply absent there);
+* ``device.live_arrays`` / ``device.live_array_bytes`` — the
+  ``jax.live_arrays()`` census: how many device buffers the process is
+  keeping alive, and their payload bytes — the leak detector;
+* ``device.compile_events`` / ``device.compile_time_s`` — cumulative
+  XLA compile activity, fed by ``jax.monitoring`` listeners installed
+  on first sample (a retrace storm shows up as a moving counter).
+
+Everything is a **host-side** query: no dispatch, no device sync, so a
+sample at a chunk boundary costs microseconds.  When the registry is
+disabled the call is one memoized-bool check.  jax is imported lazily
+— ``import hpnn_tpu.obs`` stays stdlib-only.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from hpnn_tpu.obs import registry
+
+# cumulative compile activity observed via jax.monitoring; module-level
+# on purpose: compiles are a process-wide phenomenon
+_compile = {"events": 0, "time_s": 0.0}
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _install_compile_listeners() -> None:
+    """Register jax.monitoring listeners counting compile events.  Done
+    once; listeners cannot be unregistered, so they just keep feeding
+    the module counters.  Every hook is defensive — the monitoring API
+    surface varies across jax versions."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _installed = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event, **kw):
+            if "compile" in event:
+                _compile["events"] += 1
+
+        def _on_duration(event, duration, **kw):
+            if "compile" in event:
+                _compile["events"] += 1
+                _compile["time_s"] += float(duration)
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass  # counters stay at 0; the gauges are still emitted
+
+
+def compile_stats() -> dict:
+    """Cumulative compile counters (events, time_s) seen so far."""
+    return dict(_compile)
+
+
+def sample(phase: str, step: int | None = None) -> None:
+    """Emit one device-telemetry gauge set tagged with ``phase`` (and
+    ``step`` when given).  No-op when the registry is disabled or jax
+    is unavailable."""
+    if not registry.enabled():
+        return
+    try:
+        import jax
+    except Exception:
+        return
+    _install_compile_listeners()
+    fields = {"phase": phase}
+    if step is not None:
+        fields["step"] = int(step)
+
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        devices = []
+    in_use = peak = 0
+    have_stats = False
+    for d in devices:
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            have_stats = True
+            used = int(ms.get("bytes_in_use", 0))
+            in_use += used
+            peak += int(ms.get("peak_bytes_in_use", used))
+    if have_stats:
+        registry.gauge("device.hbm_bytes_in_use", in_use, **fields)
+        registry.gauge("device.hbm_peak_bytes", peak, **fields)
+
+    try:
+        live = jax.live_arrays()
+        live_bytes = 0
+        for a in live:
+            try:
+                live_bytes += int(a.nbytes)
+            except Exception:
+                pass
+        registry.gauge("device.live_arrays", len(live), **fields)
+        registry.gauge("device.live_array_bytes", live_bytes, **fields)
+    except Exception:
+        pass
+
+    registry.gauge("device.compile_events", _compile["events"], **fields)
+    registry.gauge("device.compile_time_s",
+                   round(_compile["time_s"], 6), **fields)

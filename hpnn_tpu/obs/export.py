@@ -1,0 +1,199 @@
+"""Live metrics export: Prometheus text rendering + pull endpoints.
+
+The JSONL sink is a flight log read after landing; this module is the
+cockpit view.  It renders the registry's in-process aggregate snapshot
+(``registry.snapshot_state()``) in the Prometheus text exposition
+format (version 0.0.4) and serves it two ways:
+
+* ``GET /metrics`` on the resident serve server (serve/server.py);
+* a standalone stdlib HTTP server (:func:`start_export_server`) wired
+  to ``train_nn --export-port N`` so a training run is scrapeable
+  while it trains.
+
+Starting a server calls ``registry.activate_memory()``, so the export
+path works even when ``HPNN_METRICS`` is unset — aggregates then live
+only in memory.
+
+Mapping: obs counters become Prometheus ``counter``s (``_total``
+suffix), obs gauges become ``gauge``s, and timer/histogram aggregates
+become ``summary`` metrics — q0.5/q0.9/q0.99 are estimated from the
+registry's log2 buckets (the quantile lands in bucket ``(2^(k-1),
+2^k]``; its upper bound, clamped to the observed min/max, is the
+estimate — conservative and monotone) plus exact ``_sum``/``_count``.
+Metric names are ``hpnn_`` + the event name with non-alphanumerics
+mapped to ``_`` (``driver.chunk_dispatch`` →
+``hpnn_driver_chunk_dispatch``).
+
+``/healthz`` here reports process-level health: registry state, uptime,
+plus whatever the drivers published through :func:`set_health` (the
+fused driver publishes ``last_round`` at round end/abort).  stdlib
+only; nothing here ever writes stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from hpnn_tpu.obs import registry
+
+QUANTILES = (0.5, 0.9, 0.99)
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+_health: dict = {}
+_health_lock = threading.Lock()
+
+
+# ------------------------------------------------------------ health
+def set_health(**fields) -> None:
+    """Publish health fields (e.g. ``last_round={...}``) for the
+    ``/healthz`` endpoints.  A plain dict update — cheap enough to call
+    unconditionally from the drivers."""
+    with _health_lock:
+        _health.update(fields)
+
+
+def health() -> dict:
+    """The process-health document served on ``/healthz``."""
+    snap = registry.snapshot_state()
+    out = {
+        "status": "ok",
+        "pid": os.getpid(),
+        "metrics_active": snap is not None,
+    }
+    if snap is not None:
+        out["uptime_s"] = snap["uptime_s"]
+        out["sink"] = snap["path"]
+    with _health_lock:
+        out.update(_health)
+    return out
+
+
+def _reset_for_tests() -> None:
+    with _health_lock:
+        _health.clear()
+
+
+# ------------------------------------------------------------ render
+def _metric_name(ev: str) -> str:
+    return "hpnn_" + _NAME_RE.sub("_", ev)
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return format(f, ".9g")
+
+
+def _quantile_estimate(agg: dict, q: float) -> float:
+    """Estimate quantile ``q`` from a registry aggregate snapshot's
+    log2 buckets: walk buckets in order until the cumulative count
+    reaches ``q * n``, answer that bucket's upper bound clamped to the
+    observed [min, max]."""
+    buckets = agg.get("log2_buckets") or {}
+    n = agg.get("n") or 0
+    vmin, vmax = agg.get("min"), agg.get("max")
+    if not n or not buckets:
+        return 0.0
+    target = q * n
+    seen = 0
+    for k in sorted(buckets, key=int):
+        seen += buckets[k]
+        if seen >= target:
+            hi = 0.0 if int(k) <= 0 else 2.0 ** int(k)
+            if vmax is not None:
+                hi = min(hi, float(vmax))
+            if vmin is not None:
+                hi = max(hi, float(vmin))
+            return hi
+    return float(vmax) if vmax is not None else 0.0
+
+
+def render_prometheus(snap: dict | None) -> str:
+    """The Prometheus text exposition (0.0.4) of one registry
+    snapshot.  ``snap=None`` (registry inactive) renders a comment-only
+    document — a scrape of an idle process is 200, not an error."""
+    lines = []
+    if snap is None:
+        lines.append("# hpnn obs registry inactive "
+                     "(set HPNN_METRICS or start an export server)")
+        return "\n".join(lines) + "\n"
+    lines.append("# TYPE hpnn_obs_uptime_seconds gauge")
+    lines.append(f"hpnn_obs_uptime_seconds {_fmt(snap['uptime_s'])}")
+    for ev, total in sorted(snap["counters"].items()):
+        m = _metric_name(ev) + "_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_fmt(total)}")
+    for ev, value in sorted(snap["gauges"].items()):
+        m = _metric_name(ev)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(value)}")
+    for ev, agg in sorted(snap["aggregates"].items()):
+        m = _metric_name(ev)
+        lines.append(f"# TYPE {m} summary")
+        for q in QUANTILES:
+            est = _quantile_estimate(agg, q)
+            lines.append(f'{m}{{quantile="{q}"}} {_fmt(est)}')
+        lines.append(f"{m}_sum {_fmt(agg['total'])}")
+        lines.append(f"{m}_count {agg['n']}")
+    return "\n".join(lines) + "\n"
+
+
+def metrics_body() -> bytes:
+    """The ``/metrics`` response body for the current registry state."""
+    return render_prometheus(registry.snapshot_state()).encode("utf-8")
+
+
+# ------------------------------------------------------------ server
+class _ExportHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # stdout stays byte-frozen
+        import sys
+
+        sys.stderr.write("obs.export: %s - %s\n"
+                         % (self.address_string(), fmt % args))
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            self._send(200, metrics_body(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/healthz":
+            body = json.dumps(health()).encode("utf-8")
+            self._send(200, body, "application/json")
+        else:
+            self._send(404, b'{"error": "not found"}', "application/json")
+
+
+def start_export_server(host: str = "127.0.0.1",
+                        port: int = 0) -> ThreadingHTTPServer:
+    """Start the standalone export endpoint on a daemon thread and
+    return the server (``server.server_address`` carries the bound
+    port; pass ``port=0`` for an ephemeral one).  Activates in-memory
+    aggregation so scrapes see data even without ``HPNN_METRICS``."""
+    registry.activate_memory()
+    server = ThreadingHTTPServer((host, port), _ExportHandler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name="hpnn-obs-export", daemon=True)
+    server._thread = thread
+    thread.start()
+    bound_host, bound_port = server.server_address[:2]
+    registry.event("export.listen", host=bound_host, port=bound_port)
+    return server
+
+
+def stop_export_server(server: ThreadingHTTPServer) -> None:
+    server.shutdown()
+    server.server_close()

@@ -35,6 +35,16 @@ emitted at round end and at interpreter exit).
 Multi-process: the sink is per-process.  A ``{rank}`` placeholder in
 the path expands to the JAX process index so ranks never interleave
 writes into one file.
+
+The registry can also run **file-less**: arming the flight recorder
+(``HPNN_FLIGHT``, obs/flight.py) or starting a metrics export server
+(obs/export.py) activates in-memory aggregation even when
+``HPNN_METRICS`` is unset — every record still feeds the flight ring
+and the cumulative counters/gauges/aggregates, it just skips the JSONL
+write.  On the first activation the registry chains SIGTERM/SIGINT
+handlers and ``sys.excepthook`` so a killed or crashing run flushes
+its sink, emits a final ``summary`` line, and dumps the flight ring
+(the clean-exit path was already covered by atexit).
 """
 
 from __future__ import annotations
@@ -43,9 +53,12 @@ import atexit
 import json
 import math
 import os
+import signal
 import sys
 import threading
 import time
+
+from hpnn_tpu.obs import flight
 
 ENV_KNOB = "HPNN_METRICS"
 
@@ -143,6 +156,15 @@ class _State:
 _state: _State | bool | None = None
 _state_lock = threading.Lock()
 
+# file-less activation requested (export server) — survives until a
+# test reset; _init() then builds a _State with fp=None
+_memory_requested = False
+
+# crash handlers are chained once per process and never uninstalled;
+# they check the live _state when they fire
+_handlers_installed = False
+_prev_excepthook = None
+
 
 def _to_py(o):
     # numpy scalars and other array-likes carrying .item()
@@ -166,24 +188,31 @@ def _init():
         if _state is not None:
             return _state
         path = os.environ.get(ENV_KNOB, "")
-        if not path:
-            _state = False
-            return False
-        if "{rank}" in path:
-            path = path.replace("{rank}", str(_process_index()))
-        try:
-            fp = open(path, "a")
-        except OSError as exc:
-            # never crash (or pollute stdout) over a broken sink path
-            sys.stderr.write(
-                f"hpnn obs: cannot open metrics sink {path!r}: {exc}; "
-                "metrics disabled\n"
-            )
-            _state = False
-            return False
+        fp = None
+        if path:
+            if "{rank}" in path:
+                path = path.replace("{rank}", str(_process_index()))
+            try:
+                fp = open(path, "a")
+            except OSError as exc:
+                # never crash (or pollute stdout) over a broken sink
+                sys.stderr.write(
+                    f"hpnn obs: cannot open metrics sink {path!r}: "
+                    f"{exc}; metrics disabled\n"
+                )
+                path = ""
+                fp = None
+        if fp is None:
+            # file-less activation: the flight ring and the export
+            # snapshot still want the records even without a sink
+            if not (_memory_requested or flight.enabled()):
+                _state = False
+                return False
+            path = None
         st = _State(fp, path)
         _state = st
         atexit.register(_at_exit)
+    _install_crash_handlers()
     _emit(st, {"ev": "obs.open", "kind": "event", "pid": os.getpid(),
                "rank": _process_index()})
     return st
@@ -199,21 +228,54 @@ def _active():
 def _emit(st: _State, rec: dict) -> None:
     rec.setdefault("ts", round(time.time(), 6))
     line = json.dumps(rec, default=_to_py)
-    with st.lock:
-        st.fp.write(line + "\n")
-        st.fp.flush()
+    flight.record(line)
+    if st.fp is not None:
+        with st.lock:
+            st.fp.write(line + "\n")
+            st.fp.flush()
 
 
 def enabled() -> bool:
-    """True when a metrics sink is active (``HPNN_METRICS`` set and
-    writable).  First call reads the env; later calls are a memo hit."""
+    """True when the registry is active — a writable ``HPNN_METRICS``
+    sink, an armed flight recorder, or a running export server.  First
+    call reads the env; later calls are a memo hit."""
     return _active() is not None
 
 
 def sink_path() -> str | None:
-    """Path of the active JSONL sink, or None when disabled."""
+    """Path of the active JSONL sink, or None when disabled (or active
+    file-less — flight/export only)."""
     st = _active()
     return st.path if st else None
+
+
+def activate_memory() -> None:
+    """Activate in-memory aggregation without a JSONL sink (used by the
+    export server so ``--export-port`` works without ``--metrics``).
+    A no-op when a sink is already active; a memoized "disabled" verdict
+    is forgotten so the next call re-initializes."""
+    global _memory_requested, _state
+    _memory_requested = True
+    with _state_lock:
+        if _state is False:
+            _state = None
+    _active()
+
+
+def snapshot_state() -> dict | None:
+    """A consistent copy of the cumulative aggregates (the export
+    server's read path), or None when the registry is inactive."""
+    st = _active()
+    if st is None:
+        return None
+    with st.lock:
+        return {
+            "uptime_s": round(time.time() - st.t0, 3),
+            "path": st.path,
+            "counters": dict(st.counters),
+            "gauges": dict(st.gauges),
+            "aggregates": {k: a.snapshot() for k, a in st.aggs.items()},
+        }
 
 
 def configure(path: str | None) -> None:
@@ -352,9 +414,64 @@ def summary() -> None:
 
 def flush() -> None:
     st = _active()
-    if st is not None:
+    if st is not None and st.fp is not None:
         with st.lock:
             st.fp.flush()
+
+
+def _crash_flush(ev: str, detail: str, reason: str) -> None:
+    """Shared teardown for signals and unhandled exceptions: one marker
+    event, a final summary line, sink flush, flight dump.  Must never
+    raise — it runs inside handlers on already-dying processes."""
+    try:
+        if not isinstance(_state, _State):
+            return
+        event(ev, reason=detail)
+        summary()
+        flush()
+        flight.dump(reason)
+    except Exception:
+        pass
+
+
+def _install_crash_handlers() -> None:
+    """Chain SIGTERM/SIGINT handlers and ``sys.excepthook`` once per
+    process (atexit only covers the clean-exit path).  The previous
+    handler always runs afterwards, so a serve loop's KeyboardInterrupt
+    shutdown — or pytest's own SIGINT handling — is preserved; a
+    default-disposition SIGTERM is re-raised so the exit status stays
+    honest."""
+    global _handlers_installed, _prev_excepthook
+    if _handlers_installed:
+        return
+    _handlers_installed = True
+
+    _prev_excepthook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        _crash_flush("obs.crash", exc_type.__name__, "unhandled_exception")
+        _prev_excepthook(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+
+    if threading.current_thread() is not threading.main_thread():
+        return  # signal.signal only works from the main thread
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev = signal.getsignal(sig)
+
+            def _handler(signum, frame, _prev=prev):
+                _crash_flush("obs.signal",
+                             signal.Signals(signum).name, "signal")
+                if callable(_prev):
+                    _prev(signum, frame)
+                else:
+                    signal.signal(signum, signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+            signal.signal(sig, _handler)
+        except (ValueError, OSError):
+            pass
 
 
 def _at_exit() -> None:
@@ -362,21 +479,28 @@ def _at_exit() -> None:
     if isinstance(st, _State):
         try:
             summary()
-            st.fp.close()
+            if st.fp is not None:
+                st.fp.close()
         except Exception:
             pass
 
 
 def _reset_for_tests() -> None:
     """Forget the memoized sink (closing it if open) so the next call
-    re-reads ``HPNN_METRICS``.  Test-only — production code re-points
-    the sink through :func:`configure`."""
-    global _state
+    re-reads ``HPNN_METRICS``.  Also forgets the flight-recorder memo
+    and any file-less activation.  Test-only — production code
+    re-points the sink through :func:`configure`."""
+    global _state, _memory_requested
     with _state_lock:
         st = _state
         _state = None
-        if isinstance(st, _State):
+        _memory_requested = False
+        if isinstance(st, _State) and st.fp is not None:
             try:
                 st.fp.close()
             except Exception:
                 pass
+    flight._reset_for_tests()
+    exp = sys.modules.get("hpnn_tpu.obs.export")
+    if exp is not None:  # avoid an import cycle: export imports registry
+        exp._reset_for_tests()

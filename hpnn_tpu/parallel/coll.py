@@ -1,0 +1,64 @@
+"""Instrumented collectives: the per-layer comms census.
+
+The TP/DP collectives (``lax.all_gather``, ``lax.psum``,
+``lax.pmean``) execute *inside* jitted ``shard_map`` bodies — a host
+timer around them would time nothing (the host sees one opaque
+dispatch, already bracketed by ``driver.chunk_dispatch``).  What CAN
+be recorded honestly, at zero runtime cost, is the **trace-time comms
+census**: these wrappers emit one obs event per collective call site
+each time the enclosing program is traced (i.e. once per compile),
+tagged with the op, mesh axis, operand shape/bytes, and the caller's
+fields (``layer=...``) — a per-layer communication timeline of the
+compiled program.  A retrace storm shows up as the census re-firing
+(cross-check ``device.compile_events``).
+
+Each wrapper also opens a ``jax.named_scope("hpnn.coll.<op>")`` so
+device profiles attribute collective time to the exact call site.
+
+Host-level collectives (the census/seed/ok broadcasts in
+``parallel/dist.py``) run outside jit and get real ``obs.timer``
+brackets there (``coll.census_allgather`` etc.) — see
+docs/observability.md for the full ``coll.*`` catalog.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax import lax
+
+from hpnn_tpu import obs
+
+
+def _census(name: str, axis, x, **fields) -> None:
+    if not obs.enabled():
+        return
+    try:
+        shape = [int(s) for s in x.shape]
+        nbytes = math.prod(shape) * x.dtype.itemsize
+    except Exception:
+        shape, nbytes = None, None
+    obs.event(name, axis=str(axis), shape=shape, bytes=nbytes, **fields)
+
+
+def all_gather(x, axis, *, tiled: bool = False, **fields):
+    """``lax.all_gather`` with a trace-time ``coll.all_gather`` census
+    event and an ``hpnn.coll.all_gather`` profiler scope."""
+    _census("coll.all_gather", axis, x, tiled=tiled, **fields)
+    with jax.named_scope("hpnn.coll.all_gather"):
+        return lax.all_gather(x, axis, tiled=tiled)
+
+
+def psum(x, axis, **fields):
+    """``lax.psum`` with a trace-time ``coll.psum`` census event."""
+    _census("coll.psum", axis, x, **fields)
+    with jax.named_scope("hpnn.coll.psum"):
+        return lax.psum(x, axis)
+
+
+def pmean(x, axis, **fields):
+    """``lax.pmean`` with a trace-time ``coll.pmean`` census event."""
+    _census("coll.pmean", axis, x, **fields)
+    with jax.named_scope("hpnn.coll.pmean"):
+        return lax.pmean(x, axis)

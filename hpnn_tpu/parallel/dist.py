@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from hpnn_tpu import obs
 from hpnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -89,7 +90,8 @@ def resolve_time_seed(seed: int) -> int:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        s = int(multihost_utils.broadcast_one_to_all(np.int64(s)))
+        with obs.timer("coll.seed_broadcast", ranks=jax.process_count()):
+            s = int(multihost_utils.broadcast_one_to_all(np.int64(s)))
     return s
 
 
@@ -115,7 +117,9 @@ def census_consistent(names) -> bool:
 
     digest = hashlib.sha256("\n".join(names).encode()).digest()[:8]
     mine = np.frombuffer(digest, dtype=np.int64)
-    every = np.asarray(multihost_utils.process_allgather(mine))
+    with obs.timer("coll.census_allgather", ranks=jax.process_count(),
+                   files=len(names)):
+        every = np.asarray(multihost_utils.process_allgather(mine))
     return bool((every == every[0]).all())
 
 
@@ -131,7 +135,9 @@ def sync_rank0_ok(ok: bool) -> bool:
         return ok
     from jax.experimental import multihost_utils
 
-    return bool(multihost_utils.broadcast_one_to_all(np.int32(1 if ok else 0)))
+    with obs.timer("coll.rank0_sync", ranks=jax.process_count()):
+        return bool(
+            multihost_utils.broadcast_one_to_all(np.int32(1 if ok else 0)))
 
 
 def process_summary() -> str:

@@ -34,6 +34,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from hpnn_tpu.models import ann, snn
+from hpnn_tpu.parallel import coll
 from hpnn_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
@@ -183,12 +184,14 @@ def make_dp_train_step(mesh, *, model: str = "ann", momentum: bool = False,
 
     def local_step(weights, dw, X_loc, T_loc):
         grads = batch_grads(weights, X_loc, T_loc, model=model)
-        grads = tuple(lax.pmean(g, DATA_AXIS) for g in grads)
+        grads = tuple(coll.pmean(g, DATA_AXIS, layer=i)
+                      for i, g in enumerate(grads))
         if momentum:
             weights, dw = momentum_step(weights, dw, grads, lr, alpha)
         else:
             weights = sgd_step(weights, grads, lr)
-        loss = lax.pmean(batch_loss(weights, X_loc, T_loc, model=model), DATA_AXIS)
+        loss = coll.pmean(batch_loss(weights, X_loc, T_loc, model=model),
+                          DATA_AXIS, role="loss")
         return weights, dw, loss
 
     rep = P()

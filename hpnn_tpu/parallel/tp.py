@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from hpnn_tpu.models import ann, snn
+from hpnn_tpu.parallel import coll
 from hpnn_tpu.parallel.mesh import MODEL_AXIS, kernel_specs
 from hpnn_tpu.train.loop import SampleResult, convergence_loop, target_argmax
 
@@ -63,11 +64,12 @@ def forward_local(weights_loc, x, *, model: str, n_out: int):
             z_loc = w @ v
             if model == "snn" and l == last:
                 e_loc = jnp.exp(z_loc - 1.0)
-                e = lax.all_gather(e_loc, MODEL_AXIS, tiled=True)
+                e = coll.all_gather(e_loc, MODEL_AXIS, tiled=True, layer=l)
                 e = e * _out_mask(e.shape[0], n_out, e.dtype)
                 v = e / (TINY + jnp.sum(e))
             else:
-                v = lax.all_gather(ann.act(z_loc), MODEL_AXIS, tiled=True)
+                v = coll.all_gather(ann.act(z_loc), MODEL_AXIS, tiled=True,
+                                    layer=l)
             acts.append(v)
         return tuple(acts)
 
@@ -89,7 +91,8 @@ def deltas_local(weights_loc, acts, target, *, model: str, k: int):
         ds = [d]
         for l in range(len(weights_loc) - 1, 0, -1):
             part = weights_loc[l].T @ _my_block(ds[0], k)
-            ds.insert(0, lax.psum(part, MODEL_AXIS) * ann.dact(acts[l]))
+            ds.insert(0, coll.psum(part, MODEL_AXIS, layer=l)
+                      * ann.dact(acts[l]))
         return tuple(ds)
 
 

@@ -164,6 +164,15 @@ class Batcher:
         with self._lock:
             return len(self._queue)
 
+    def oldest_age(self) -> float | None:
+        """Age (clock seconds) of the oldest queued request, or None
+        when the queue is empty — the /healthz staleness signal."""
+        with self._lock:
+            if not self._queue:
+                return None
+            submitted = self._queue[0].submitted
+        return max(0.0, self._clock() - submitted)
+
     # ------------------------------------------------------------ drain
     def _take_batch(self, block: bool) -> list[_Request] | None:
         """Pop one coalesced batch (or None).  Expired requests are

@@ -15,7 +15,10 @@ same Session, for drivers that aren't Python:
   **429** queue full (retriable, ``Retry-After`` set), **504**
   deadline exceeded (retriable).
 * ``POST /v1/reload`` ``{"kernel": n}`` → re-read the kernel file.
-* ``GET /healthz`` → kernel census.
+* ``GET /healthz`` → kernel/bucket census, bucket-compile count,
+  per-kernel queue depth + oldest-waiter age, process obs health.
+* ``GET /metrics`` → the obs aggregate snapshot in Prometheus text
+  format (obs/export.py; docs/observability.md).
 
 Nothing here writes stdout (request logging is suppressed; errors go
 to stderr) — the token protocol stays byte-frozen even when a server
@@ -103,6 +106,25 @@ class Session:
     def kernels(self) -> list[str]:
         return self.registry.names()
 
+    def health(self) -> dict:
+        """The /healthz document: kernel census, bucket-compile census,
+        and per-batcher queue depth + oldest-waiter age."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        doc = {
+            "status": "ok",
+            "kernels": self.registry.names(),
+            "buckets": list(self.engine.buckets),
+            "compiled": self.engine.compiled_count(),
+            "batchers": {
+                name: {"depth": b.depth(),
+                       "oldest_wait_s": b.oldest_age()}
+                for name, b in batchers.items()
+            },
+        }
+        doc["obs"] = obs.export.health()
+        return doc
+
     # ------------------------------------------------------------ infer
     def batcher_for(self, name: str) -> Batcher:
         self.registry.get(name)  # KeyError for unknown kernels
@@ -175,10 +197,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._reply(200, {"status": "ok",
-                              "kernels": self.session.kernels(),
-                              "buckets": list(
-                                  self.session.engine.buckets)})
+            self._reply(200, self.session.health())
+        elif self.path == "/metrics":
+            body = obs.export.metrics_body()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._reply(404, {"error": f"no such path {self.path}"})
 

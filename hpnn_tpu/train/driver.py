@@ -308,6 +308,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             chunk=chunk, body="pallas" if use_pallas_epoch else "lax",
             resumed=state is not None,
         )
+        obs.device.sample("round_start")
         fname_it = iter(zip(files, readable))
 
         def emit_header_only_until_readable(silent=False):
@@ -389,6 +390,10 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 obs.event("round.abort", mode="fused", done=done,
                           exc=type(exc).__name__)
                 obs.flush()
+                obs.flight.dump("round.abort")
+                obs.export.set_health(last_round={
+                    "mode": "fused", "ok": False, "done": done,
+                    "exc": type(exc).__name__})
                 raise
             done += int(Xc.shape[0])
             chunk_i += 1
@@ -400,6 +405,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 obs.count("train.first_ok", n=int(stats[3].sum()))
                 obs.count("train.final_ok", n=int(stats[4].sum()))
                 obs.gauge("fuse.chunk_size", chunk, done=done)
+                obs.device.sample("chunk", step=chunk_i)
             trace_mod.trace(f"w@{done}", weights)
             if state_path:
                 host_w = tuple(np.asarray(w) for w in weights)
@@ -418,6 +424,10 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         obs.event("round.end", mode="fused", samples=done,
                   chunks=chunk_i, body="pallas" if use_pallas_epoch
                   else "lax")
+        obs.device.sample("round_end")
+        obs.export.set_health(last_round={
+            "mode": "fused", "ok": True, "samples": done,
+            "chunks": chunk_i})
     else:
         # streaming path; reuse pre-parsed samples when a fused attempt
         # bailed (zero trainable samples — all entries None) rather
@@ -455,6 +465,9 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             obs.count("train.first_ok", n=first_oks)
             obs.count("train.final_ok", n=final_oks)
         obs.event("round.end", mode="streaming", samples=len(files))
+        obs.device.sample("round_end")
+        obs.export.set_health(last_round={
+            "mode": "streaming", "ok": True, "samples": len(files)})
     if tp_state is not None:
         from hpnn_tpu.parallel import dp, mesh as mesh_mod
 
@@ -818,6 +831,7 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
     obs.event("eval.round", files=len(files), batched=len(out_of),
               odd=len(odd), unreadable=len(bad),
               tp=sharded is not None)
+    obs.device.sample("eval")
 
     from hpnn_tpu.utils.glibc_random import shuffled_order
 

@@ -30,6 +30,14 @@ def main() -> int:
     assert runtime.get_capabilities() & runtime.NNCap.MPI
     assert runtime.get_mpi_tasks() == 2
 
+    # EVERY rank emits obs events (obs is per-process, unlike the
+    # rank-0-only token logger): when the driver sets HPNN_METRICS with
+    # a {rank} placeholder, each process gets its own sink file and
+    # test_dist.py asserts the streams never interleave
+    from hpnn_tpu import obs
+
+    obs.event("round.start", mode="dist", rank=jax.process_index())
+
     mesh = dist.hybrid_mesh(n_model=1)
     n_data = mesh.shape[mesh_mod.DATA_AXIS]
     assert n_data == jax.device_count() == 4
@@ -63,6 +71,10 @@ def main() -> int:
 
     w_sh, _, loss = step(w_sh, (), Xs, Ts)
     jax.block_until_ready(loss)
+    obs.event("round.end", mode="dist", rank=jax.process_index(),
+              loss=float(loss))
+    obs.summary()
+    obs.flush()
     # rank-0-only token: exactly one process may emit this line
     log.nn_out(sys.stdout, "DIST STEP loss= %.10f tasks=%i\n",
                float(loss), runtime.get_mpi_tasks())

@@ -33,8 +33,8 @@ def test_lint_catches_a_perturbed_stream(tmp_path, monkeypatch):
 
     real = mod._run_round
 
-    def leaky(tmpdir, metrics_path):
-        out = real(tmpdir, metrics_path)
+    def leaky(tmpdir, metrics_path, probe=None):
+        out = real(tmpdir, metrics_path, probe=probe)
         if metrics_path is not None:
             out += '{"ev": "leak", "kind": "event"}\n'
         return out

@@ -98,7 +98,12 @@ def test_two_process_cluster(tmp_path):
     """Spawn TWO OS processes (coordinator + worker) that join one JAX
     cluster through runtime.init_dist, build dist.hybrid_mesh over the
     global 4-device mesh, run one GSPMD DP step, and print through the
-    rank-0-only logger — `mpirun -np 2` end to end, CPU-backed."""
+    rank-0-only logger — `mpirun -np 2` end to end, CPU-backed.
+
+    The cluster runs with ``HPNN_METRICS`` pointed at a ``{rank}``
+    path: each process must expand its own sink file, the two streams
+    must never interleave, and ``tools/obs_report.py --merge`` must
+    reconstruct one cross-rank timeline from them."""
     worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
     port = _free_port()
     # clean CPU interpreters: strip the accelerator plugin's env
@@ -117,6 +122,8 @@ def test_two_process_cluster(tmp_path):
     env_base["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
     env_base["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
     env_base["JAX_NUM_PROCESSES"] = "2"
+    # per-rank obs sinks: the literal {rank} expands inside each worker
+    env_base["HPNN_METRICS"] = str(tmp_path / "run.{rank}.jsonl")
     procs = []
     for rank in (0, 1):
         env = dict(env_base, JAX_PROCESS_ID=str(rank))
@@ -146,6 +153,49 @@ def test_two_process_cluster(tmp_path):
     assert "NN: DIST STEP loss= " in outs[0]
     assert "tasks=2" in outs[0]
     assert "DIST STEP" not in outs[1]
+
+    # --- {rank} sink expansion: one file per process, no interleaving
+    import json
+
+    assert not (tmp_path / "run.{rank}.jsonl").exists()
+    per_rank = []
+    for rank in (0, 1):
+        sink = tmp_path / f"run.{rank}.jsonl"
+        assert sink.exists(), f"rank {rank} sink missing"
+        recs = [json.loads(ln)
+                for ln in sink.read_text().splitlines() if ln.strip()]
+        assert recs, f"rank {rank} sink empty"
+        opens = [r for r in recs if r.get("ev") == "obs.open"]
+        assert opens and opens[0]["rank"] == rank
+        # every rank-tagged record in this file carries THIS rank —
+        # a foreign tag would mean the streams interleaved
+        for r in recs:
+            if "rank" in r:
+                assert r["rank"] == rank, r
+        names = {r.get("ev") for r in recs}
+        # the host-collective comms timeline (dist.resolve_time_seed)
+        assert "coll.seed_broadcast" in names
+        assert {"round.start", "round.end", "obs.summary"} <= names
+        per_rank.append(recs)
+
+    # --- cross-rank reconstruction via tools/obs_report.py --merge
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "obs_report.py"))
+    rpt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rpt)
+    merged = rpt.merge_events(
+        [str(tmp_path / f"run.{r}.jsonl") for r in (0, 1)])
+    assert len(merged) == len(per_rank[0]) + len(per_rank[1])
+    assert all("rank" in r for r in merged)
+    assert {r["rank"] for r in merged} == {0, 1}
+    # the merge must preserve each rank's own emission order exactly
+    for rank in (0, 1):
+        evs = [r["ev"] for r in merged if r["rank"] == rank]
+        assert evs == [r.get("ev") for r in per_rank[rank]]
 
 
 # --------------------------------------------------------------------------

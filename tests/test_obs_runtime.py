@@ -1,0 +1,471 @@
+"""Runtime health telemetry: device samples, the flight recorder, the
+live Prometheus export, and the extended serve health surface.
+
+The JSONL sink (test_obs.py) answers "what happened"; this file covers
+the *while-it-runs* and *after-it-died* surfaces — device gauges at
+round boundaries, `/metrics` scraped under live traffic, `/healthz`
+queue staleness, the bounded flight ring and its crash dumps, and the
+cross-rank merge that joins per-rank sinks into one timeline."""
+
+import http.client
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import export, flight
+from hpnn_tpu.serve.batcher import Batcher
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _kernel():
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return k
+
+
+# ------------------------------------------------------------- device
+def test_device_sample_emits_gauges(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    obs.device.sample("unit", step=3)
+    recs = _read(sink)
+    by = {r["ev"]: r for r in recs}
+    # the always-available census gauges (HBM stats are backend-gated)
+    for name in ("device.live_arrays", "device.live_array_bytes",
+                 "device.compile_events", "device.compile_time_s"):
+        assert name in by, sorted(by)
+        assert by[name]["kind"] == "gauge"
+        assert by[name]["phase"] == "unit"
+        assert by[name]["step"] == 3
+
+
+def test_device_sample_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    monkeypatch.delenv("HPNN_FLIGHT", raising=False)
+    obs._reset_for_tests()
+    obs.device.sample("unit")          # no sink, no raise, no files
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_driver_round_samples_device_telemetry(tmp_path, monkeypatch):
+    """The fused driver samples at round_start / chunk / round_end."""
+    from hpnn_tpu.train import driver
+
+    from tests.test_obs import _conf
+
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    assert driver.train_kernel(_conf(tmp_path))
+    recs = [r for r in _read(sink) if r["ev"] == "device.live_arrays"]
+    phases = {r["phase"] for r in recs}
+    assert {"round_start", "chunk", "round_end"} <= phases
+
+
+# ------------------------------------------------------------- export
+def test_snapshot_state_and_prometheus_grammar(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    obs._reset_for_tests()
+    obs.count("unit.hits", n=3)
+    obs.gauge("unit.depth", 7.0)
+    obs.observe("unit.lat", [1.0, 2.0, 3.0, 10.0])
+    with obs.timer("unit.block"):
+        pass
+    snap = obs.snapshot_state()
+    assert snap["counters"] == {"unit.hits": 3}
+    assert snap["gauges"] == {"unit.depth": 7.0}
+    assert snap["aggregates"]["unit.lat"]["n"] == 4
+
+    text = export.render_prometheus(snap)
+    assert "# TYPE hpnn_unit_hits_total counter" in text
+    assert "hpnn_unit_hits_total 3" in text
+    assert "# TYPE hpnn_unit_depth gauge" in text
+    assert "hpnn_unit_depth 7" in text
+    assert "# TYPE hpnn_unit_lat summary" in text
+    assert "hpnn_unit_lat_sum 16" in text
+    assert "hpnn_unit_lat_count 4" in text
+
+    # exposition-format grammar: every sample line is NAME{labels} VALUE
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+$")
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE "), line
+        else:
+            assert sample.match(line), line
+
+    # quantile estimates are monotone and inside [min, max]
+    qs = [float(m.group(1)) for m in re.finditer(
+        r'hpnn_unit_lat\{quantile="[0-9.]+"\} ([0-9.eE+-]+)', text)]
+    assert len(qs) == 3
+    assert qs == sorted(qs)
+    assert 1.0 <= qs[0] and qs[-1] <= 10.0
+
+
+def test_render_inactive_is_a_comment(monkeypatch):
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    text = export.render_prometheus(obs.snapshot_state())
+    assert text.startswith("#")
+
+
+def test_standalone_export_server_fileless(monkeypatch):
+    """--export-port without --metrics: the server activates in-memory
+    aggregation; scrapes see data, /healthz reports no sink."""
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    server = export.start_export_server(port=0)
+    try:
+        assert obs.enabled() and obs.sink_path() is None
+        obs.count("unit.fileless", n=2)
+        host, port = server.server_address[:2]
+        cn = http.client.HTTPConnection(host, port, timeout=10)
+        cn.request("GET", "/metrics")
+        resp = cn.getresponse()
+        assert resp.status == 200
+        assert "version=0.0.4" in resp.getheader("Content-Type")
+        body = resp.read().decode()
+        assert "hpnn_unit_fileless_total 2" in body
+        # export.listen itself lands in the aggregates? no — it is a
+        # point event; but the health doc must see the active registry
+        cn.request("GET", "/healthz")
+        health = json.loads(cn.getresponse().read())
+        assert health["metrics_active"] is True
+        assert health["sink"] is None
+        cn.request("GET", "/nope")
+        assert cn.getresponse().read() and True
+        cn.close()
+    finally:
+        export.stop_export_server(server)
+
+
+def test_serve_metrics_round_trip_under_traffic(tmp_path, monkeypatch):
+    """GET /metrics on the serving server returns valid exposition
+    while requests flow, and the serve.request count matches."""
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    sess.infer("k", np.zeros(8))   # at least one completed request
+    server = serve.make_server(sess, port=0)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                sess.infer("k", np.zeros(8))
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            cn = http.client.HTTPConnection(host, port, timeout=10)
+            cn.request("GET", "/metrics")
+            resp = cn.getresponse()
+            assert resp.status == 200
+            assert "version=0.0.4" in resp.getheader("Content-Type")
+            live = resp.read().decode()
+            cn.close()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert "# TYPE hpnn_serve_request summary" in live
+        # a fresh scrape after traffic stopped: exact request count
+        n = 5
+        for _ in range(n):
+            sess.infer("k", np.zeros(8))
+        body = export.metrics_body().decode()
+        m = re.search(r"^hpnn_serve_request_count (\d+)$", body,
+                      re.MULTILINE)
+        assert m and int(m.group(1)) >= n
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+
+
+def test_serve_healthz_reports_queue_state(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    sess.infer("k", np.zeros(8))       # materialize the batcher
+    server = serve.make_server(sess, port=0)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        cn = http.client.HTTPConnection(host, port, timeout=10)
+        cn.request("GET", "/healthz")
+        health = json.loads(cn.getresponse().read())
+        cn.close()
+        assert health["status"] == "ok"
+        assert health["kernels"] == ["k"]
+        assert health["compiled"] == len(sess.engine.buckets)
+        b = health["batchers"]["k"]
+        assert b["depth"] == 0
+        assert b["oldest_wait_s"] is None    # idle queue
+        assert health["obs"]["metrics_active"] is True
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+
+
+def test_export_health_carries_last_round(tmp_path, monkeypatch):
+    from hpnn_tpu.train import driver
+
+    from tests.test_obs import _conf
+
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    obs._reset_for_tests()
+    assert driver.train_kernel(_conf(tmp_path))
+    h = export.health()
+    assert h["last_round"]["ok"] is True
+    assert h["last_round"]["mode"] == "fused"
+    assert h["last_round"]["samples"] == 6
+
+
+def test_batcher_oldest_age_fake_clock():
+    now = [100.0]
+    b = Batcher(lambda payloads: [None] * len(payloads),
+                clock=lambda: now[0], start=False)
+    assert b.oldest_age() is None
+    b.submit("a", timeout_s=60.0)
+    now[0] = 101.5
+    b.submit("b", timeout_s=60.0)
+    assert b.oldest_age() == pytest.approx(1.5)
+    b.drain_once()
+    assert b.oldest_age() is None
+    b.close()
+
+
+# ------------------------------------------------------------- flight
+def test_flight_ring_bounded_and_fileless(tmp_path, monkeypatch):
+    dump = tmp_path / "flight.jsonl"
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    monkeypatch.setenv("HPNN_FLIGHT", str(dump))
+    monkeypatch.setenv("HPNN_FLIGHT_N", "8")
+    obs._reset_for_tests()
+    # arming the recorder activates the registry file-less
+    assert obs.enabled()
+    assert obs.sink_path() is None
+    assert flight.enabled() and flight.dump_path() == str(dump)
+    for i in range(30):
+        obs.event("unit.tick", i=i)
+    assert not dump.exists()           # memory-only until a trigger
+    path = obs.flight.dump("manual")
+    assert path == str(dump)
+    recs = _read(dump)
+    header = recs[0]
+    assert header["ev"] == "flight.dump"
+    assert header["reason"] == "manual"
+    assert header["capacity"] == 8
+    assert header["events"] == 8
+    ticks = [r for r in recs[1:] if r["ev"] == "unit.tick"]
+    # the ring kept exactly the LAST 8 events, oldest first
+    assert [r["i"] for r in ticks] == list(range(22, 30))
+
+
+def test_flight_cap_floor(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_FLIGHT", str(tmp_path / "f.jsonl"))
+    monkeypatch.setenv("HPNN_FLIGHT_N", "2")     # below the floor
+    obs._reset_for_tests()
+    obs.event("unit.one")
+    obs.flight.dump("floor")
+    assert _read(tmp_path / "f.jsonl")[0]["capacity"] == 8
+
+
+def test_flight_rank_placeholder(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_FLIGHT", str(tmp_path / "f.{rank}.jsonl"))
+    obs._reset_for_tests()
+    assert flight.dump_path() == str(tmp_path / "f.0.jsonl")
+
+
+def test_flight_dump_failure_warns_not_raises(tmp_path, monkeypatch,
+                                              capsys):
+    monkeypatch.setenv(
+        "HPNN_FLIGHT", str(tmp_path / "no" / "dir" / "f.jsonl"))
+    obs._reset_for_tests()
+    obs.event("unit.x")
+    assert obs.flight.dump("broken") is None
+    out = capsys.readouterr()
+    assert out.out == ""
+    assert "flight dump failed" in out.err
+
+
+def test_postmortem_recovers_preabort_events(tmp_path, monkeypatch):
+    """The acceptance postmortem: a dispatch crash (the in-process
+    stand-in for a SIGKILL'd worker) aborts the round; the flight dump
+    must contain the pre-abort story — round.start, the failed
+    dispatch, the halving, the abort — even with NO metrics sink."""
+    import jax
+
+    from hpnn_tpu.train import driver, loop
+
+    from tests.test_obs import _conf
+
+    dump = tmp_path / "flight.jsonl"
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    monkeypatch.setenv("HPNN_FLIGHT", str(dump))
+    monkeypatch.setenv("HPNN_FUSE_STATE", str(tmp_path / "st.npz"))
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "128")
+    obs._reset_for_tests()
+
+    real = loop.train_epoch_lax
+    boom = {"armed": True}
+
+    def crash_once(*a, **k):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise jax.errors.JaxRuntimeError("worker died (simulated)")
+        return real(*a, **k)
+
+    monkeypatch.setattr(loop, "train_epoch_lax", crash_once)
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        driver.train_kernel(_conf(tmp_path))
+
+    assert dump.exists()
+    recs = _read(dump)
+    assert recs[0]["ev"] == "flight.dump"
+    assert recs[0]["reason"] == "round.abort"
+    names = [r["ev"] for r in recs[1:]]
+    assert "round.start" in names
+    i_fail = names.index("driver.chunk_dispatch")
+    # JaxRuntimeError may surface under its concrete XLA name
+    assert recs[1:][i_fail]["failed"].endswith("RuntimeError")
+    assert "fuse.chunk_halved" in names
+    assert names.index("round.abort") > i_fail
+
+
+def test_sigterm_flushes_sink_and_dumps_flight(tmp_path):
+    """A SIGTERM'd process must leave a flushed sink (obs.signal +
+    final obs.summary) and a flight dump with reason "signal" — and
+    still die with the honest SIGTERM exit status."""
+    sink = tmp_path / "m.jsonl"
+    dump = tmp_path / "f.jsonl"
+    script = (
+        "import os, signal\n"
+        "from hpnn_tpu import obs\n"
+        "obs.event('unit.work', i=1)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "raise SystemExit('unreachable')\n"
+    )
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "PALLAS_", "AXON_", "TPU_"))
+           and k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT
+    env["HPNN_METRICS"] = str(sink)
+    env["HPNN_FLIGHT"] = str(dump)
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == -signal.SIGTERM, (p.returncode, p.stderr)
+    assert p.stdout == ""              # stdout stays byte-frozen
+
+    recs = _read(sink)
+    names = [r["ev"] for r in recs]
+    assert "unit.work" in names
+    i_sig = names.index("obs.signal")
+    assert recs[i_sig]["reason"] == "SIGTERM"
+    assert names.index("obs.summary") > i_sig   # final summary flushed
+    drecs = _read(dump)
+    assert drecs[0]["ev"] == "flight.dump"
+    assert drecs[0]["reason"] == "signal"
+    assert any(r["ev"] == "unit.work" for r in drecs[1:])
+
+
+# -------------------------------------------------------------- merge
+def test_merge_events_skew_tolerance(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(ROOT, "tools", "obs_report.py"))
+    rpt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rpt)
+
+    # rank 0's host clock steps BACKWARDS mid-run (ts 10 -> 5 -> 20)
+    r0 = tmp_path / "run.0.jsonl"
+    r0.write_text("\n".join(json.dumps(r) for r in [
+        {"ts": 1.0, "ev": "obs.open", "kind": "event", "rank": 0},
+        {"ts": 10.0, "ev": "a.first", "kind": "event"},
+        {"ts": 5.0, "ev": "a.second", "kind": "event"},
+        {"ts": 20.0, "ev": "a.third", "kind": "event"},
+    ]) + "\n")
+    r1 = tmp_path / "run.1.jsonl"
+    r1.write_text("\n".join(json.dumps(r) for r in [
+        {"ts": 1.5, "ev": "obs.open", "kind": "event", "rank": 1},
+        {"ts": 11.0, "ev": "b.first", "kind": "event"},
+        {"ts": 12.0, "ev": "b.second", "kind": "event"},
+    ]) + "\n")
+
+    merged = rpt.merge_events([str(r0), str(r1)])
+    assert all("rank" in r for r in merged)
+    # a rank is never reordered against itself (clamped monotone) ...
+    evs0 = [r["ev"] for r in merged if r["rank"] == 0]
+    assert evs0 == ["obs.open", "a.first", "a.second", "a.third"]
+    # ... and the peers interleave by (clamped) timestamp: rank 1's
+    # 11.0/12.0 land between rank 0's 10.0 and 20.0
+    evs = [r["ev"] for r in merged]
+    assert evs.index("a.first") < evs.index("b.first")
+    assert evs.index("b.second") < evs.index("a.third")
+
+    # the CLI: --merge + --out writes the merged timeline
+    out = tmp_path / "merged.jsonl"
+    rc = rpt.main(["--merge", str(r0), str(r1), "--out", str(out),
+                   "--json"])
+    assert rc == 0
+    assert len(_read(out)) == 7
+    # several paths without --merge is a usage error
+    assert rpt.main([str(r0), str(r1)]) == 2
+
+
+# ---------------------------------------------------------- train_nn
+def _train_workdir(tmp_path):
+    from tests.test_obs import _conf
+
+    _conf(tmp_path)                    # writes tmp_path/samples
+    (tmp_path / "nn.conf").write_text(
+        "[name] XP\n[type] ANN\n[init] generate\n[seed] 1234\n"
+        "[input] 8\n[hidden] 5\n[output] 2\n[train] BP\n"
+        "[sample_dir] ./samples\n[test_dir] ./samples\n")
+
+
+def test_train_nn_export_port_flag(tmp_path, monkeypatch, capsys):
+    """--export-port 0 binds an ephemeral /metrics endpoint for the
+    run's duration (stderr names it; stdout stays token-only)."""
+    from hpnn_tpu.cli import train_nn
+
+    _train_workdir(tmp_path)
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    rc = train_nn.main(["--export-port", "0", "nn.conf"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "train_nn: metrics export on http://" in err
+    assert (tmp_path / "kernel.opt").exists()
+
+
+def test_train_nn_export_port_validation(capsys):
+    from hpnn_tpu.cli import train_nn
+
+    assert train_nn.main(["--export-port", "99999", "nn.conf"]) == -1
+    assert "bad --export-port" in capsys.readouterr().err
+    assert train_nn.main(["--export-port", "abc", "nn.conf"]) == -1
+    assert "bad --export-port" in capsys.readouterr().err

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Event-catalog drift lint for the obs subsystem.
+
+docs/observability.md (plus the serving catalog in docs/serving.md)
+promises a complete event-name catalog.  That promise rots silently:
+an instrumented site added without a docs row leaves operators grepping
+a name the docs never mention.  This lint closes the loop — it greps
+every ``obs.event/count/gauge/observe/timer`` call site (and the raw
+``"ev": "name"`` records the registry/flight emit directly) under
+``hpnn_tpu/`` for **literal dotted event names**, collects every
+backticked dotted name from the docs pages, and fails when an emitted
+name is missing from the docs.
+
+The check is one-directional on purpose: the docs may document names
+that only fire on TPU hardware or in multi-process runs (emitted ⊆
+documented, not ==).  A documented prefix wildcard like ``serve.*``
+covers the whole family.
+
+Dynamic names (a variable first argument) are invisible to the grep —
+the emitting style in this repo is literal-names-only precisely so
+this lint stays sound.
+
+Run standalone (exit code for CI)::
+
+    python tools/check_obs_catalog.py
+
+or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# obs.event("a.b", ...), count/gauge/observe/timer — any dotted-prefix
+# caller spelling (obs.timer, registry.event, plain event) counts
+CALL_RE = re.compile(
+    r"(?:[\w.]+\.)?(?:event|count|gauge|observe|timer)\(\s*"
+    r"[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+)[\"']"
+)
+# records built by hand: {"ev": "obs.open", ...}
+RAW_RE = re.compile(
+    r"[\"']ev[\"']\s*:\s*[\"']([a-z0-9_]+(?:\.[a-z0-9_]+)+)[\"']"
+)
+# docs side: every `backticked.dotted.name`; `family.*` is a wildcard
+DOC_RE = re.compile(
+    r"`([a-z0-9_]+(?:\.(?:[a-z0-9_]+|\*))+)`"
+)
+
+DOC_PAGES = ("docs/observability.md", "docs/serving.md")
+SRC_DIR = "hpnn_tpu"
+
+
+def emitted_names(root: str) -> dict[str, list[str]]:
+    """name -> ["path:line", ...] for every literal emission site."""
+    names: dict[str, list[str]] = {}
+    src = os.path.join(root, SRC_DIR)
+    for dirpath, _dirs, files in os.walk(src):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path) as fp:
+                for lineno, line in enumerate(fp, 1):
+                    for rx in (CALL_RE, RAW_RE):
+                        for m in rx.finditer(line):
+                            names.setdefault(m.group(1), []).append(
+                                f"{rel}:{lineno}")
+    return names
+
+
+def documented_names(root: str) -> set[str]:
+    names: set[str] = set()
+    for page in DOC_PAGES:
+        path = os.path.join(root, page)
+        try:
+            with open(path) as fp:
+                text = fp.read()
+        except OSError:
+            continue
+        names.update(DOC_RE.findall(text))
+    return names
+
+
+def _covered(name: str, documented: set[str]) -> bool:
+    if name in documented:
+        return True
+    # `serve.*` in the docs covers serve.request, serve.compile, ...
+    parts = name.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        if ".".join(parts[:i]) + ".*" in documented:
+            return True
+    return False
+
+
+def check(root: str) -> list[str]:
+    """Run the lint; returns a list of failure strings (empty = pass)."""
+    emitted = emitted_names(root)
+    documented = documented_names(root)
+    if not emitted:
+        return [f"no emission sites found under {SRC_DIR}/ — "
+                "the call-site regex is broken"]
+    if not documented:
+        return ["no documented names found in "
+                + " / ".join(DOC_PAGES)]
+    failures = []
+    for name in sorted(emitted):
+        if not _covered(name, documented):
+            sites = ", ".join(emitted[name][:3])
+            failures.append(
+                f"event {name!r} (emitted at {sites}) is missing from "
+                f"the docs catalog ({' / '.join(DOC_PAGES)})")
+    return failures
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    failures = check(root)
+    if failures:
+        for f in failures:
+            sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")
+        return 1
+    n = len(emitted_names(root))
+    sys.stderr.write(f"check_obs_catalog: OK — {n} emitted names all "
+                     "documented\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,13 @@ unset, once with it pointed at a JSONL sink — and asserts
 3. no line smells of JSON or obs vocabulary (the sink never leaks),
 4. the instrumented run's sink is non-empty and carries the tentpole
    events (dispatch timer, chunk gauge, n_iter histogram, round
-   events).
+   events, device telemetry).
+
+The instrumented run enables the whole surface at once — JSONL sink,
+flight-recorder ring (``HPNN_FLIGHT``), device telemetry, and a live
+export server whose ``/metrics`` endpoint is scraped inside the
+capture window — so "byte-frozen" is proven against the maximal
+configuration, not the minimal one.
 
 Run standalone (exit code for CI)::
 
@@ -77,8 +83,13 @@ def _tiny_conf(tmpdir: str):
                   train=NNTrain.BP, samples=sdir, tests=sdir)
 
 
-def _run_round(tmpdir: str, metrics_path: str | None) -> str:
-    """One train+eval round, stdout captured; returns the capture."""
+def _run_round(tmpdir: str, metrics_path: str | None,
+               probe=None) -> str:
+    """One train+eval round, stdout captured; returns the capture.
+
+    ``probe`` (optional) runs after the round while stdout is still
+    redirected and the obs state is still live — the hook the export
+    check uses to scrape /metrics inside the capture window."""
     from hpnn_tpu import obs
     from hpnn_tpu.train import driver
     from hpnn_tpu.utils import logging as log
@@ -92,6 +103,8 @@ def _run_round(tmpdir: str, metrics_path: str | None) -> str:
             if not driver.train_kernel(conf):
                 raise RuntimeError("train_kernel failed")
             driver.run_kernel(conf)
+            if probe is not None:
+                probe()
     finally:
         log.set_verbose(0)
         obs.configure(None)
@@ -103,12 +116,44 @@ def check(tmpdir: str) -> list[str]:
     failures = []
     sink = os.path.join(tmpdir, "obs.jsonl")
     plain = _run_round(os.path.join(tmpdir, "a"), None)
-    instrumented = _run_round(os.path.join(tmpdir, "b"), sink)
+
+    # the instrumented run turns EVERYTHING on at once: the JSONL sink,
+    # the flight recorder ring, the device-telemetry samples (they ride
+    # obs.enabled()), and a live export server scraped mid-capture —
+    # stdout must still not move by a byte
+    scraped = {}
+
+    def probe():
+        from urllib.request import urlopen
+
+        from hpnn_tpu.obs import export
+
+        server = export.start_export_server(port=0)
+        try:
+            port = server.server_address[1]
+            scraped["metrics"] = urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=10).read().decode()
+        finally:
+            export.stop_export_server(server)
+
+    os.environ["HPNN_FLIGHT"] = os.path.join(tmpdir, "flight.jsonl")
+    try:
+        instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
+                                  probe=probe)
+    finally:
+        os.environ.pop("HPNN_FLIGHT", None)
 
     if plain != instrumented:
         failures.append(
-            "stdout is NOT byte-identical with HPNN_METRICS set "
+            "stdout is NOT byte-identical with HPNN_METRICS + "
+            "HPNN_FLIGHT + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
+    body = scraped.get("metrics", "")
+    if "# TYPE" not in body or "hpnn_" not in body:
+        failures.append(
+            "live /metrics scrape is not Prometheus text exposition "
+            f"(got {body[:80]!r})")
     if not plain.strip():
         failures.append("no stdout captured — the round emitted nothing")
 
@@ -152,7 +197,8 @@ def check(tmpdir: str) -> list[str]:
         failures.append("metrics sink is empty")
     names = {r.get("ev") for r in recs}
     for want in ("round.start", "driver.chunk_dispatch", "train.n_iter",
-                 "fuse.chunk_size", "round.end", "obs.summary"):
+                 "fuse.chunk_size", "round.end", "obs.summary",
+                 "device.live_arrays"):
         if want not in names:
             failures.append(f"metrics sink missing event {want!r}")
     return failures

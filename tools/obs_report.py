@@ -5,12 +5,22 @@ Usage::
 
     python tools/obs_report.py run.metrics.jsonl          # text report
     python tools/obs_report.py run.metrics.jsonl --json   # machine form
+    python tools/obs_report.py --merge run.0.jsonl run.1.jsonl \
+        [--out merged.jsonl]                              # cross-rank
 
 Reads the event stream produced by ``hpnn_tpu.obs`` (schema:
 docs/observability.md) and prints, in order: the run header, lifecycle
 events, counter totals, timer stats, histograms (with ASCII log2-bucket
 bars), the fused-round chunk-dispatch timeline, and the
 fallback/resume event log in emission order.
+
+``--merge`` joins the per-rank sinks a ``{rank}`` path produced into
+one cross-rank timeline: every record is tagged with its rank (taken
+from the stream's ``obs.open`` line, else the file position), per-rank
+timestamps are clamped monotone (a stepped host clock must not reorder
+one rank's own emission order), and the streams are stably merged by
+``(ts, rank, seq)`` — skew between hosts cannot interleave a rank
+against itself, only shift it against its peers.
 
 stdlib-only on purpose: the report must render on a login node with no
 jax installed, and ``bench.py`` imports :func:`summarize` in-process.
@@ -45,6 +55,37 @@ def load_events(path: str) -> list[dict]:
             except json.JSONDecodeError:
                 continue  # torn tail line from a crashed writer
     return events
+
+
+def merge_events(paths: list[str]) -> list[dict]:
+    """Join per-rank JSONL sinks into one skew-tolerant timeline.
+
+    Each file's records are tagged ``rank`` (from its ``obs.open``
+    line when present, else the argument position) and kept in their
+    original emission order: per-rank timestamps are clamped monotone
+    non-decreasing before the merge, so a host clock stepping backwards
+    mid-run cannot reorder a rank against itself.  The streams are then
+    stably sorted by ``(ts, rank, seq)``.
+    """
+    tagged = []
+    for pos, path in enumerate(paths):
+        events = load_events(path)
+        rank = pos
+        for rec in events:
+            if rec.get("ev") == "obs.open" and "rank" in rec:
+                rank = int(rec["rank"])
+                break
+        last_ts = 0.0
+        for seq, rec in enumerate(events):
+            ts = rec.get("ts")
+            ts = float(ts) if isinstance(ts, (int, float)) else last_ts
+            ts = max(ts, last_ts)
+            last_ts = ts
+            rec = dict(rec)
+            rec.setdefault("rank", rank)
+            tagged.append((ts, rank, seq, rec))
+    tagged.sort(key=lambda t: t[:3])
+    return [rec for _ts, _rank, _seq, rec in tagged]
 
 
 def _merge_hist(dst: dict, rec: dict) -> None:
@@ -137,6 +178,10 @@ def render(rep: dict) -> str:
     if s:
         w(f"uptime: {s.get('uptime_s', '?')} s"
           f"   (summary lines use the cumulative aggregates)")
+    ranks = rep.get("ranks")
+    if ranks:
+        w("ranks: " + ", ".join(
+            f"{k}: {v} events" for k, v in ranks.items()))
     for rec in rep["rounds"]:
         fields = {k: v for k, v in rec.items()
                   if k not in ("ts", "ev", "kind")}
@@ -204,16 +249,47 @@ def render(rep: dict) -> str:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize an HPNN_METRICS JSONL sink")
-    ap.add_argument("path", help="metrics JSONL file")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="metrics JSONL file (several with --merge)")
     ap.add_argument("--json", action="store_true",
                     help="print the report as JSON instead of text")
+    ap.add_argument("--merge", action="store_true",
+                    help="join several {rank}-expanded sinks into one "
+                         "cross-rank timeline (skew-tolerant ordering)")
+    ap.add_argument("--out", metavar="FILE",
+                    help="with --merge: also write the merged JSONL "
+                         "timeline to FILE")
     args = ap.parse_args(argv)
+    if len(args.paths) > 1 and not args.merge:
+        sys.stderr.write("obs_report: several paths need --merge\n")
+        return 2
+    if args.out and not args.merge:
+        sys.stderr.write("obs_report: --out needs --merge\n")
+        return 2
     try:
-        events = load_events(args.path)
+        if args.merge:
+            events = merge_events(args.paths)
+        else:
+            events = load_events(args.paths[0])
     except OSError as exc:
         sys.stderr.write(f"obs_report: {exc}\n")
         return 1
     rep = summarize(events)
+    if args.merge:
+        ranks: dict = {}
+        for rec in events:
+            r = rec.get("rank")
+            ranks[r] = ranks.get(r, 0) + 1
+        rep["ranks"] = {str(k): ranks[k]
+                        for k in sorted(ranks, key=str)}
+        if args.out:
+            try:
+                with open(args.out, "w") as fp:
+                    for rec in events:
+                        fp.write(json.dumps(rec, default=str) + "\n")
+            except OSError as exc:
+                sys.stderr.write(f"obs_report: {exc}\n")
+                return 1
     if args.json:
         json.dump(rep, sys.stdout, indent=2, default=str)
         sys.stdout.write("\n")
