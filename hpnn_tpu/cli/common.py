@@ -147,6 +147,11 @@ def validate_long_opts(opts: dict) -> bool:
         if not ok:
             sys.stderr.write("syntax error: bad --lr parameter!\n")
             return False
+    numerics = opts.get("numerics")
+    if numerics not in (None, "warn", "abort"):
+        sys.stderr.write(
+            "syntax error: bad --numerics parameter (want warn|abort)!\n")
+        return False
     return True
 
 

@@ -17,7 +17,8 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
-        argv, flags=("batch",), valued=("mesh", "profile", "metrics")
+        argv, flags=("batch",),
+        valued=("mesh", "profile", "metrics", "ledger", "numerics")
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
@@ -27,6 +28,17 @@ def main(argv: list[str] | None = None) -> int:
         from hpnn_tpu import obs
 
         obs.configure(opts["metrics"])
+    if "ledger" in opts:
+        # --ledger PATH == HPNN_LEDGER=PATH: the per-round checksum
+        # ledger (compare runs with tools/ledger_diff.py)
+        from hpnn_tpu.obs import ledger as obs_ledger
+
+        obs_ledger.configure(opts["ledger"])
+    if "numerics" in opts:
+        # --numerics warn|abort == HPNN_NUMERICS: the sentinel mode
+        from hpnn_tpu.obs import probes as obs_probes
+
+        obs_probes.configure_mode(opts["numerics"])
     tp_mesh = None
     if "mesh" in opts:
         if opts.get("batch"):
@@ -48,13 +60,22 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
-    with common.profile_trace(opts.get("profile")):
-        if opts.get("batch"):
-            from hpnn_tpu.train import batch as batch_mod
+    from hpnn_tpu.obs.probes import NumericsError
 
-            batch_mod.run_kernel_batched(conf)
-        else:
-            driver.run_kernel(conf, mesh=tp_mesh)
+    try:
+        with common.profile_trace(opts.get("profile")):
+            if opts.get("batch"):
+                from hpnn_tpu.train import batch as batch_mod
+
+                batch_mod.run_kernel_batched(conf)
+            else:
+                driver.run_kernel(conf, mesh=tp_mesh)
+    except NumericsError as exc:
+        # the sentinel already emitted the events, flushed the sink,
+        # and dumped the flight ring — exit non-zero, no traceback
+        sys.stderr.write(f"FAILED: numerics sentinel abort: {exc}\n")
+        runtime.deinit_all()
+        return -1
     runtime.deinit_all()
     return 0
 

@@ -19,7 +19,7 @@ def main(argv: list[str] | None = None) -> int:
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
         argv, valued=("batch", "epochs", "mesh", "profile", "lr",
-                      "metrics", "export-port")
+                      "metrics", "export-port", "ledger", "numerics")
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
@@ -30,6 +30,17 @@ def main(argv: list[str] | None = None) -> int:
         from hpnn_tpu import obs
 
         obs.configure(opts["metrics"])
+    if "ledger" in opts:
+        # --ledger PATH == HPNN_LEDGER=PATH: the per-round checksum
+        # ledger (compare runs with tools/ledger_diff.py)
+        from hpnn_tpu.obs import ledger as obs_ledger
+
+        obs_ledger.configure(opts["ledger"])
+    if "numerics" in opts:
+        # --numerics warn|abort == HPNN_NUMERICS: the sentinel mode
+        from hpnn_tpu.obs import probes as obs_probes
+
+        obs_probes.configure_mode(opts["numerics"])
     export_server = None
     if "export-port" in opts:
         # live Prometheus scrape endpoint for the whole run; works with
@@ -98,19 +109,28 @@ def _run(argv: list[str], opts: dict) -> int:
             sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
         runtime.deinit_all()
         return -1
-    with common.profile_trace(opts.get("profile")):
-        if "batch" in opts:
-            from hpnn_tpu.train import batch as batch_mod
+    from hpnn_tpu.obs.probes import NumericsError
 
-            ok = batch_mod.train_kernel_batched(
-                conf,
-                batch_size=int(opts["batch"]),
-                epochs=int(opts.get("epochs", "1")),
-                mesh_spec=opts.get("mesh"),
-                lr=float(opts["lr"]) if "lr" in opts else None,
-            )
-        else:
-            ok = driver.train_kernel(conf, mesh=tp_mesh)
+    try:
+        with common.profile_trace(opts.get("profile")):
+            if "batch" in opts:
+                from hpnn_tpu.train import batch as batch_mod
+
+                ok = batch_mod.train_kernel_batched(
+                    conf,
+                    batch_size=int(opts["batch"]),
+                    epochs=int(opts.get("epochs", "1")),
+                    mesh_spec=opts.get("mesh"),
+                    lr=float(opts["lr"]) if "lr" in opts else None,
+                )
+            else:
+                ok = driver.train_kernel(conf, mesh=tp_mesh)
+    except NumericsError as exc:
+        # the sentinel already emitted the events, flushed the sink,
+        # and dumped the flight ring — exit non-zero, no traceback
+        sys.stderr.write(f"FAILED: numerics sentinel abort: {exc}\n")
+        runtime.deinit_all()
+        return -1
     if not ok:
         sys.stderr.write("FAILED to train kernel!\n")
         runtime.deinit_all()

@@ -94,6 +94,21 @@ def generate(
     return Kernel(tuple(weights)), seed
 
 
+def weight_names(n_layers: int) -> tuple[str, ...]:
+    """Stable per-layer tensor names (``w0`` .. ``w{n-1}``) — the key
+    vocabulary of the checksum ledger and the ``numerics.*`` probes
+    (obs/probes.py).  ``w{n-1}`` is the output layer; there are no
+    separate bias vectors in this port (the reference folds none into
+    ``kernel_ann`` either)."""
+    return tuple(f"w{i}" for i in range(n_layers))
+
+
+def named_weights(weights) -> dict:
+    """``{"w0": arr, ...}`` view of a weights tuple (or Kernel.weights)."""
+    ws = tuple(weights)
+    return dict(zip(weight_names(len(ws)), ws))
+
+
 def zeros_like_momentum(kernel: Kernel) -> Kernel:
     """Momentum ``dw`` arrays (ref: ``ann_momentum_init``, src/ann.c:1876)."""
     return Kernel(tuple(np.zeros_like(np.asarray(w)) for w in kernel.weights))

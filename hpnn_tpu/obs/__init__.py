@@ -19,7 +19,12 @@ structured side channel next to it:
   exceptions, and SIGTERM/SIGINT — ``HPNN_FLIGHT=<path>``
   (obs/flight.py);
 * a run-report summarizer over the JSONL, including a ``--merge``
-  cross-rank timeline join (tools/obs_report.py).
+  cross-rank timeline join (tools/obs_report.py);
+* numerics observability: per-tensor probes (absmax/L2/mean/NaN/Inf),
+  a per-round checksum ledger gated by ``HPNN_LEDGER=<path>``
+  (obs/ledger.py, diff tool: tools/ledger_diff.py), and a cross-rank
+  divergence sentinel under the reference 1e-14/1e-12 tolerances —
+  ``HPNN_PROBES`` / ``HPNN_NUMERICS=warn|abort`` (obs/probes.py).
 
 Typical instrumentation site::
 
@@ -33,7 +38,7 @@ Typical instrumentation site::
 Event-name catalog and schema: docs/observability.md.
 """
 
-from hpnn_tpu.obs import device, export, flight
+from hpnn_tpu.obs import device, export, flight, ledger, probes
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -65,7 +70,9 @@ __all__ = [
     "flight",
     "flush",
     "gauge",
+    "ledger",
     "observe",
+    "probes",
     "sink_path",
     "snapshot_state",
     "step_annotation",

@@ -501,6 +501,10 @@ def _reset_for_tests() -> None:
             except Exception:
                 pass
     flight._reset_for_tests()
-    exp = sys.modules.get("hpnn_tpu.obs.export")
-    if exp is not None:  # avoid an import cycle: export imports registry
-        exp._reset_for_tests()
+    # chain the sibling memos; sys.modules.get avoids import cycles
+    # (export/ledger/probes all import registry)
+    for name in ("hpnn_tpu.obs.export", "hpnn_tpu.obs.ledger",
+                 "hpnn_tpu.obs.probes"):
+        mod = sys.modules.get(name)
+        if mod is not None:
+            mod._reset_for_tests()

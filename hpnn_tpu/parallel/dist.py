@@ -123,6 +123,27 @@ def census_consistent(names) -> bool:
     return bool((every == every[0]).all())
 
 
+def allgather_checksums(vec) -> np.ndarray:
+    """All-gather one rank's per-tensor checksum vector; returns a
+    ``(n_ranks, n_tensors)`` f64 matrix (every rank sees every rank's
+    values, so every rank reaches the same divergence verdict — no rank
+    is left behind in a collective).  Identity ``(1, n)`` reshape
+    single-process.  The divergence sentinel (obs/probes.py via
+    ``dp.divergence_check``) compares the rows under the reference
+    1e-14/1e-12 tolerances."""
+    import jax
+
+    v = np.asarray(vec, dtype=np.float64).reshape(-1)
+    if jax.process_count() < 2:
+        return v.reshape(1, -1)
+    from jax.experimental import multihost_utils
+
+    with obs.timer("coll.checksum_allgather", ranks=jax.process_count(),
+                   n=v.size):
+        every = np.asarray(multihost_utils.process_allgather(v))
+    return every.reshape(jax.process_count(), -1)
+
+
 def sync_rank0_ok(ok: bool) -> bool:
     """Broadcast a rank-0 outcome so every rank takes the same branch
     (e.g. rank 0's kernel-file write: peers must not proceed into

@@ -340,6 +340,49 @@ def make_gspmd_epoch_fn(mesh, weights, *, model: str = "ann",
     )
 
 
+def divergence_check(names, values, tols, *, step=None, where=None):
+    """Cross-rank checksum comparison: the divergence sentinel's core.
+
+    Each rank holds the same-ordered per-tensor checksum list (abs-sums
+    from obs/probes.py); all ranks all-gather them
+    (``dist.allgather_checksums``) and compare columns against rank 0
+    under the per-tensor tolerances (1e-14 vectors / 1e-12 matrices —
+    the reference ChangeLog:33-38 criterion).  Returns a list of
+    finding dicts ``{"tensor", "spread", "tol", "values"}`` — empty
+    when ranks agree or the process is alone.  Pure comparison: event
+    emission / abort policy live in the caller (obs/probes.py)."""
+    from hpnn_tpu.parallel import dist
+
+    every = dist.allgather_checksums(values)
+    if every.shape[0] < 2:
+        return []
+    findings = []
+    for i, name in enumerate(names):
+        col = every[:, i]
+        if np.isnan(col).any():
+            # NaN breaks |a-b| comparisons: a column is divergent iff
+            # SOME ranks went NaN and others did not; all-NaN ranks
+            # "agree" (the numerics.nan event covers that failure)
+            if np.isnan(col).all():
+                continue
+            findings.append({
+                "tensor": name,
+                "spread": float("nan"),
+                "tol": float(tols[i]),
+                "values": [float(v) for v in col],
+            })
+            continue
+        spread = float(np.abs(col - col[0]).max())
+        if spread > float(tols[i]):
+            findings.append({
+                "tensor": name,
+                "spread": spread,
+                "tol": float(tols[i]),
+                "values": [float(v) for v in col],
+            })
+    return findings
+
+
 def shard_batch(X, T, mesh):
     """Place a (B, n) batch with B on the data axis.
 

@@ -264,6 +264,11 @@ class Engine:
         with obs.timer("serve.forward", kernel=entry_name,
                        rows=sum(counts)):
             out = self.run_rows(entry, np.concatenate(blocks, axis=0))
+        if obs.probes.enabled():
+            # serve-side NaN tripwire: census the outputs (already host
+            # numpy) into the per-kernel /healthz numerics verdict
+            obs.probes.note_serve(entry_name, rows=int(out.shape[0]),
+                                  nan=int(np.isnan(out).sum()))
         results = []
         start = 0
         for c in counts:

@@ -122,6 +122,7 @@ class Session:
                 for name, b in batchers.items()
             },
         }
+        doc["numerics"] = obs.probes.health_doc(self.registry.names())
         doc["obs"] = obs.export.health()
         return doc
 

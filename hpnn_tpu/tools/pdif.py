@@ -301,6 +301,37 @@ def dump_help() -> None:
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--json" in argv:
+        # machine-readable mode (for CI / ledger_diff-style consumers):
+        # the whole text protocol still runs — captured, not printed —
+        # and one JSON report document goes to the real stdout
+        argv.remove("--json")
+        return _main_json(argv)
+    return _run(argv, None)
+
+
+def _main_json(argv: list[str]) -> int:
+    import contextlib
+    import io
+    import json
+
+    report = {"ok": True, "params": {}, "written": [], "skipped": []}
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        code = _run(argv, report)
+    report["exit_code"] = code
+    report["ok"] = code == 0
+    report["stdout_lines"] = buf.getvalue().splitlines()
+    sys.stdout.write(json.dumps(report) + "\n")
+    return code
+
+
+def _skip(report, name: str, reason: str) -> None:
+    if report is not None:
+        report["skipped"].append({"file": name, "reason": reason})
+
+
+def _run(argv: list[str], report) -> int:
     if len(argv) < 3:
         dump_help()
         return 1
@@ -349,6 +380,10 @@ def main(argv: list[str] | None = None) -> int:
         i += 1
     if sample_dir is None:
         sample_dir = "./samples"
+    if report is not None:
+        report["params"] = {"rruff_dir": rruff_dir, "n_inputs": n_inputs,
+                            "n_outputs": n_outputs,
+                            "sample_dir": sample_dir}
     sys.stdout.write(
         ">> received: %s -i %i -o %i -s %s\n"
         % (rruff_dir, n_inputs, n_outputs, sample_dir)
@@ -367,23 +402,32 @@ def main(argv: list[str] | None = None) -> int:
         dif = read_dif(os.path.join(dif_dir, name))
         if dif is None:
             sys.stderr.write(f"ERROR:  reading {name} file! SKIP\n")
+            _skip(report, name, "read_dif")
             continue
         if dif.lambda_ == 0.710730:
             sys.stderr.write(
                 f"ERROR:  file {name} has wavelength of 0.710730! SKIP\n"
             )
+            _skip(report, name, "mo_radiation")
             continue
         raw_path = os.path.join(rruff_dir, "raw", name)
         if not read_raw(raw_path, dif):
             sys.stderr.write(f"ERROR: reading {raw_path} file! SKIP\n")
+            _skip(report, name, "raw")
             continue
         out_path = os.path.join(sample_dir, name)
         try:
             with open(out_path, "w") as fp:
                 if not dif_2_sample(dif, fp, n_inputs, n_outputs):
                     sys.stderr.write(f"ERROR: writting {out_path} sample file!\n")
+                    # the partial [input] header stays behind, like the
+                    # reference — reported as skipped, not written
+                    _skip(report, name, "zero_spectrum")
+                elif report is not None:
+                    report["written"].append(name)
         except OSError:
             sys.stderr.write(f"ERROR: opening {out_path} sample file for WRITE!\n")
+            _skip(report, name, "open")
             continue
     return 0
 

@@ -822,6 +822,12 @@ def train_kernel_batched(
                 epoch += 1
                 loss = float(losses[e].mean())
                 print_epoch(epoch, loss, int(counts[e]))
+            if obs.probes.enabled():
+                # per-BLOCK numerics check (the scan returns only the
+                # final weights); placed OUTSIDE the dispatch try so a
+                # sentinel abort propagates honestly
+                obs.probes.check_weights(w_sh, step=epoch,
+                                         where="batch_block")
             # per-BLOCK weight trace (the multi-epoch scan returns only
             # the final weights; per-epoch snapshots would defeat the
             # fused dispatch).  enabled() gate BEFORE the host_fetch —
@@ -844,6 +850,9 @@ def train_kernel_batched(
             out = np.asarray(eval_fn(w_sh, X_eval))
             okc = accuracy_counts(out, T, model)
             print_epoch(epoch, loss, okc)
+            if obs.probes.enabled():
+                obs.probes.check_weights(w_sh, step=epoch,
+                                         where="batch_epoch")
             if trace_mod.enabled():
                 trace_mod.trace(f"w@{epoch}", [dp.host_fetch(w, mesh)
                                                for w in w_sh])

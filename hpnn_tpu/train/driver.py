@@ -406,6 +406,12 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                 obs.count("train.final_ok", n=int(stats[4].sum()))
                 obs.gauge("fuse.chunk_size", chunk, done=done)
                 obs.device.sample("chunk", step=chunk_i)
+            if obs.probes.enabled():
+                # the numerics check sits OUTSIDE the dispatch
+                # try/except above: a sentinel abort must propagate
+                # honestly, never be mistaken for a dispatch crash
+                obs.probes.check_weights(weights, step=done,
+                                         where="fused_chunk")
             trace_mod.trace(f"w@{done}", weights)
             if state_path:
                 host_w = tuple(np.asarray(w) for w in weights)
@@ -464,6 +470,9 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             obs.count("train.samples", n=len(n_iters))
             obs.count("train.first_ok", n=first_oks)
             obs.count("train.final_ok", n=final_oks)
+        if obs.probes.enabled():
+            obs.probes.check_weights(weights, step=len(files),
+                                     where="round")
         obs.event("round.end", mode="streaming", samples=len(files))
         obs.device.sample("round_end")
         obs.export.set_health(last_round={
@@ -754,6 +763,11 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
     from hpnn_tpu.utils import debug
 
     debug.device_alloc_report(tuple(w_sh))
+
+    if obs.probes.enabled():
+        # host copies sidestep TP padding entirely: the recorded shapes
+        # and means match the kernel the user loaded, not the mesh
+        obs.probes.check_weights(tuple(weights_np), step=0, where="eval")
 
     conf.seed = dist.resolve_time_seed(conf.seed)
 

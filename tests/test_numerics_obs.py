@@ -1,0 +1,567 @@
+"""Numerics observability: probes, the checksum ledger, and the
+cross-rank divergence sentinel (ISSUE 4 acceptance criteria).
+
+Covers: inactive-by-default no-ops, same-seed ledger reproducibility
+under the reference 1e-14/1e-12 tolerances, `tools/ledger_diff.py`
+verdicts at the tolerance boundaries, a NaN injected mid-round being
+caught within one round (warn continues / abort raises, flight dump
+carries the last clean checksums), the sentinel firing on simulated
+rank disagreement, the CLI abort path exiting non-zero, and the serve
+/healthz numerics verdict."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs
+from hpnn_tpu.config import NNConf, NNTrain, NNType
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import ledger, probes
+from hpnn_tpu.obs.probes import NumericsError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _conf(tmp_path, n=6):
+    rng = np.random.RandomState(0)
+    sdir = tmp_path / "samples"
+    sdir.mkdir(exist_ok=True)
+    for i in range(n):
+        c = i % 2
+        x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
+            + 0.1 * rng.normal(size=8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        with open(sdir / f"s{i:05d}.txt", "w") as fp:
+            fp.write("[input] 8\n" + " ".join(f"{v:.5f}" for v in x) + "\n")
+            fp.write("[output] 2\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return NNConf(name="t", type=NNType.ANN, seed=1, kernel=k,
+                  train=NNTrain.BP, samples=str(sdir), tests=str(sdir))
+
+
+def _kernel():
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return k
+
+
+# ------------------------------------------------------------ basics
+def test_inactive_by_default(tmp_path, monkeypatch):
+    for knob in ("HPNN_PROBES", "HPNN_NUMERICS", "HPNN_LEDGER",
+                 "HPNN_METRICS"):
+        monkeypatch.delenv(knob, raising=False)
+    obs._reset_for_tests()
+    assert not probes.enabled()
+    assert probes.mode() == "off"
+    assert not ledger.enabled()
+    assert ledger.last_row() is None
+    assert probes.check_weights(_kernel().weights, step=0,
+                                where="unit") is None
+    assert probes.health_doc(["k"]) == {"mode": "off"}
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_weight_names_and_named_weights():
+    assert kernel_mod.weight_names(3) == ("w0", "w1", "w2")
+    k = _kernel()
+    named = kernel_mod.named_weights(k.weights)
+    assert list(named) == ["w0", "w1"]
+    assert named["w1"].shape == (2, 5)
+
+
+def test_tolerance_rule():
+    # matrix iff >= 2 dims of extent > 1 (reference ChangeLog:33-38)
+    assert probes.tolerance_for([5, 8]) == 1e-12
+    assert probes.tolerance_for([8]) == 1e-14
+    assert probes.tolerance_for([1, 8]) == 1e-14
+    assert probes.tolerance_for([8, 1]) == 1e-14
+    ld = _load_tool("ledger_diff")
+    for shape in ([5, 8], [8], [1, 8]):
+        assert ld.tolerance_for(shape) == probes.tolerance_for(shape)
+
+
+def test_check_weights_emits_and_records(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_PROBES", "1")
+    monkeypatch.setenv("HPNN_LEDGER", str(tmp_path / "led.jsonl"))
+    obs._reset_for_tests()
+    k = _kernel()
+    v = probes.check_weights(k.weights, step=3, where="unit")
+    assert v["clean"] and v["nan"] == 0 and v["row"] == 0
+    recs = _read(tmp_path / "m.jsonl")
+    by = {}
+    for r in recs:
+        by.setdefault(r["ev"], []).append(r)
+    assert len(by["numerics.probe"]) == 2          # one per tensor
+    p0 = by["numerics.probe"][0]
+    assert p0["tensor"] == "w0" and p0["abs_sum"] > 0
+    assert p0["l2"] > 0 and p0["nan"] == 0
+    ck = by["numerics.checksum"][0]
+    assert ck["clean"] is True
+    assert set(ck["checksums"]) == {"w0", "w1"}
+    for g in ("numerics.nan_count", "numerics.inf_count",
+              "numerics.absmax"):
+        assert g in by
+    rows = [r for r in _read(tmp_path / "led.jsonl")
+            if r["ev"] == "ledger.round"]
+    assert rows[0]["checksums"]["w0"] == pytest.approx(
+        float(np.abs(np.asarray(k.weights[0])).sum()), abs=1e-13)
+    assert rows[0]["shapes"] == {"w0": [5, 8], "w1": [2, 5]}
+    assert ledger.last_row() == 0
+
+
+# ------------------------------------------------- ledger + diff tool
+def _train_with_ledger(tmp_path, subdir, monkeypatch):
+    from hpnn_tpu.train import driver
+
+    led = tmp_path / f"ledger_{subdir}.jsonl"
+    monkeypatch.setenv("HPNN_LEDGER", str(led))
+    obs._reset_for_tests()
+    work = tmp_path / subdir
+    work.mkdir()
+    conf = _conf(work)
+    assert driver.train_kernel(conf)
+    driver.run_kernel(conf)
+    obs._reset_for_tests()      # close the ledger file
+    return led
+
+
+def test_same_seed_runs_diff_clean(tmp_path, monkeypatch, capsys):
+    """AC: two independent same-seed CPU runs produce ledgers that
+    ledger_diff reports clean under the reference tolerances."""
+    led_a = _train_with_ledger(tmp_path, "a", monkeypatch)
+    led_b = _train_with_ledger(tmp_path, "b", monkeypatch)
+    monkeypatch.delenv("HPNN_LEDGER", raising=False)
+    obs._reset_for_tests()
+    ld = _load_tool("ledger_diff")
+    rows_a, rows_b = ld.load_rounds(str(led_a)), ld.load_rounds(str(led_b))
+    assert rows_a and len(rows_a) == len(rows_b)
+    assert {r["where"] for r in rows_a} >= {"fused_chunk", "eval"}
+    report = ld.compare(rows_a, rows_b)
+    assert report["clean"], report["divergent"]
+    assert ld.main([str(led_a), str(led_b)]) == 0
+    out = capsys.readouterr().out
+    assert "verdict: CLEAN" in out
+    # the ledgers also pass the frozen-schema lint
+    cat = _load_tool("check_obs_catalog")
+    assert cat.lint_ledger(str(led_a)) == []
+
+
+def test_ledger_diff_divergent_and_json(tmp_path, monkeypatch, capsys):
+    led_a = _train_with_ledger(tmp_path, "a", monkeypatch)
+    monkeypatch.delenv("HPNN_LEDGER", raising=False)
+    obs._reset_for_tests()
+    tampered = tmp_path / "tampered.jsonl"
+    with open(led_a) as fp, open(tampered, "w") as out:
+        for ln in fp:
+            rec = json.loads(ln)
+            if rec.get("ev") == "ledger.round":
+                rec["checksums"]["w0"] += 1e-6
+            out.write(json.dumps(rec) + "\n")
+    ld = _load_tool("ledger_diff")
+    assert ld.main([str(led_a), str(tampered), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert not report["clean"]
+    assert report["divergent"][0]["tensor"] == "w0"
+    assert report["divergent"][0]["reason"] == "tolerance"
+    assert report["max_abs_diff"] == pytest.approx(1e-6, rel=1e-3)
+    # a loosened tolerance accepts the same pair
+    assert ld.main([str(led_a), str(tampered),
+                    "--mat-tol", "1e-3", "--vec-tol", "1e-3"]) == 0
+
+
+def _synth_ledger(path, checksums, shape):
+    with open(path, "w") as fp:
+        fp.write(json.dumps({"ts": 0, "ev": "ledger.open", "path": path,
+                             "pid": 1, "rank": 0}) + "\n")
+        fp.write(json.dumps({
+            "ts": 0, "ev": "ledger.round", "row": 0, "step": 1,
+            "where": "t", "rank": 0, "nan": 0, "inf": 0,
+            "checksums": checksums,
+            "shapes": {k: shape for k in checksums}}) + "\n")
+
+
+def test_ledger_diff_tolerance_boundaries(tmp_path):
+    ld = _load_tool("ledger_diff")
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    # matrix: 5e-13 passes the 1e-12 bar, 2e-12 fails it
+    _synth_ledger(a, {"w0": 1.0}, [5, 8])
+    _synth_ledger(b, {"w0": 1.0 + 5e-13}, [5, 8])
+    assert ld.compare(ld.load_rounds(a), ld.load_rounds(b))["clean"]
+    _synth_ledger(b, {"w0": 1.0 + 2e-12}, [5, 8])
+    assert not ld.compare(ld.load_rounds(a), ld.load_rounds(b))["clean"]
+    # vector: 5e-15 passes the 1e-14 bar, 2e-14 fails it
+    _synth_ledger(a, {"v": 1.0}, [8])
+    _synth_ledger(b, {"v": 1.0 + 5e-15}, [8])
+    assert ld.compare(ld.load_rounds(a), ld.load_rounds(b))["clean"]
+    _synth_ledger(b, {"v": 1.0 + 2e-14}, [8])
+    report = ld.compare(ld.load_rounds(a), ld.load_rounds(b))
+    assert not report["clean"]
+    assert report["divergent"][0]["tol"] == 1e-14
+    # row-count mismatch is divergence, not silence
+    with open(b, "a") as fp:
+        fp.write(json.dumps({
+            "ts": 0, "ev": "ledger.round", "row": 1, "step": 2,
+            "where": "t", "rank": 0, "nan": 0, "inf": 0,
+            "checksums": {"v": 1.0}, "shapes": {"v": [8]}}) + "\n")
+    reasons = [d["reason"] for d in
+               ld.compare(ld.load_rounds(a), ld.load_rounds(b))["divergent"]]
+    assert "row_count" in reasons
+
+
+def test_ledger_schema_lint_catches_drift(tmp_path):
+    cat = _load_tool("check_obs_catalog")
+    bad = tmp_path / "bad.jsonl"
+    with open(bad, "w") as fp:
+        fp.write(json.dumps({"ts": 0, "ev": "ledger.open", "path": "x",
+                             "pid": 1, "rank": 0}) + "\n")
+        # row index jumps, shapes key set mismatches, nan negative
+        fp.write(json.dumps({
+            "ts": 0, "ev": "ledger.round", "row": 3, "step": 1,
+            "where": "t", "rank": 0, "nan": -1, "inf": 0,
+            "checksums": {"w0": 1.0},
+            "shapes": {"w1": [2, 5]}}) + "\n")
+        fp.write("not json\n")
+    failures = cat.lint_ledger(str(bad))
+    text = "\n".join(failures)
+    assert "not monotone" in text
+    assert "shapes keys" in text
+    assert "nan census" in text
+    assert "not JSON" in text
+
+
+# --------------------------------------------- NaN injection (the AC)
+def _poison_second_chunk(monkeypatch):
+    """Monkeypatch the fused-epoch body so the SECOND chunk returns
+    weights with one NaN planted — the mid-round corruption of the
+    acceptance criterion."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.train import loop
+
+    orig = loop.train_epoch_lax
+    calls = {"n": 0}
+
+    def poisoned(w, m0, Xc, Tc, *args, **kwargs):
+        out_w, stats = orig(w, m0, Xc, Tc, *args, **kwargs)
+        calls["n"] += 1
+        if calls["n"] == 2:
+            out_w = (out_w[0].at[0, 0].set(jnp.nan),) + tuple(out_w[1:])
+        return out_w, stats
+
+    monkeypatch.setattr(loop, "train_epoch_lax", poisoned)
+    return calls
+
+
+def test_nan_injection_abort_with_postmortem(tmp_path, monkeypatch):
+    """AC: a NaN injected mid-round is detected within one round under
+    abort mode — NumericsError raised, flight dump written, the last
+    CLEAN checksums recoverable from the dump, ledger row 0 clean."""
+    from hpnn_tpu.train import driver
+
+    dump = tmp_path / "flight.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_FLIGHT", str(dump))
+    monkeypatch.setenv("HPNN_LEDGER", str(tmp_path / "led.jsonl"))
+    monkeypatch.setenv("HPNN_NUMERICS", "abort")
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "2")     # 6 samples, 3 chunks
+    obs._reset_for_tests()
+    _poison_second_chunk(monkeypatch)
+    with pytest.raises(NumericsError, match="NaN"):
+        driver.train_kernel(_conf(tmp_path))
+    # flight dump: the postmortem carries the failure AND the last
+    # clean checksums (the step-2 numerics.checksum record)
+    assert dump.exists()
+    recs = _read(dump)
+    nans = [r for r in recs if r.get("ev") == "numerics.nan"]
+    assert nans and nans[0]["step"] == 4           # chunk 2 boundary
+    cks = [r for r in recs if r.get("ev") == "numerics.checksum"]
+    clean = [r for r in cks if r["clean"]]
+    assert clean and clean[-1]["step"] == 2
+    assert all(np.isfinite(v) for v in clean[-1]["checksums"].values())
+    # ledger: row 0 (chunk 1) clean, row 1 (chunk 2) carries the NaN
+    rows = [r for r in _read(tmp_path / "led.jsonl")
+            if r["ev"] == "ledger.round"]
+    assert rows[0]["nan"] == 0
+    assert rows[1]["nan"] == 1
+    assert any(v != v for v in rows[1]["checksums"].values())
+
+
+def test_nan_injection_warn_continues(tmp_path, monkeypatch):
+    from hpnn_tpu.train import driver
+
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_NUMERICS", "warn")
+    monkeypatch.setenv("HPNN_FUSE_CHUNK", "2")
+    obs._reset_for_tests()
+    _poison_second_chunk(monkeypatch)
+    assert driver.train_kernel(_conf(tmp_path)) is True
+    evs = [r["ev"] for r in _read(tmp_path / "m.jsonl")]
+    assert "numerics.nan" in evs
+    assert "round.end" in evs                      # the round finished
+    assert probes.last_verdict()["clean"] is False
+
+
+def test_bad_mode_falls_back_to_warn(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("HPNN_NUMERICS", "explode")
+    obs._reset_for_tests()
+    assert probes.mode() == "warn"
+    assert "unknown HPNN_NUMERICS" in capsys.readouterr().err
+
+
+# ------------------------------------------------ divergence sentinel
+def test_divergence_check_verdicts():
+    from hpnn_tpu.parallel import dist, dp
+
+    # single process: identity gather, no findings possible
+    assert dp.divergence_check(["w0"], [1.0], [1e-12]) == []
+    orig = dist.allgather_checksums
+    try:
+        # two simulated ranks disagreeing on w1 only
+        dist.allgather_checksums = lambda v: np.stack([
+            np.asarray(v, float),
+            np.asarray(v, float) + np.array([0.0, 1e-6])])
+        found = dp.divergence_check(["w0", "w1"], [1.0, 2.0],
+                                    [1e-12, 1e-12])
+        assert [f["tensor"] for f in found] == ["w1"]
+        assert found[0]["spread"] == pytest.approx(1e-6)
+        assert found[0]["values"] == pytest.approx([2.0, 2.0 + 1e-6])
+        # within tolerance: clean
+        dist.allgather_checksums = lambda v: np.stack([
+            np.asarray(v, float), np.asarray(v, float) + 1e-14])
+        assert dp.divergence_check(["w0"], [1.0], [1e-12]) == []
+        # all-NaN column agrees (numerics.nan covers it); mixed diverges
+        dist.allgather_checksums = lambda v: np.array(
+            [[np.nan], [np.nan]])
+        assert dp.divergence_check(["w0"], [np.nan], [1e-12]) == []
+        dist.allgather_checksums = lambda v: np.array([[1.0], [np.nan]])
+        found = dp.divergence_check(["w0"], [1.0], [1e-12])
+        assert found and found[0]["spread"] != found[0]["spread"]
+    finally:
+        dist.allgather_checksums = orig
+
+
+def test_divergence_sentinel_aborts(tmp_path, monkeypatch):
+    from hpnn_tpu.parallel import dist
+
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_FLIGHT", str(tmp_path / "flight.jsonl"))
+    monkeypatch.setenv("HPNN_NUMERICS", "abort")
+    obs._reset_for_tests()
+    monkeypatch.setattr(
+        dist, "allgather_checksums",
+        lambda v: np.stack([np.asarray(v, float),
+                            np.asarray(v, float) + 1e-6]))
+    with pytest.raises(NumericsError, match="divergence"):
+        probes.check_weights(_kernel().weights, step=1, where="unit")
+    recs = _read(tmp_path / "m.jsonl")
+    div = [r for r in recs if r["ev"] == "numerics.divergence"]
+    assert div and set(div[0]["tensors"]) == {"w0", "w1"}
+    assert div[0]["detail"][0]["tol"] == 1e-12
+    assert (tmp_path / "flight.jsonl").exists()
+    assert probes.last_verdict()["divergent"] is True
+
+
+# ----------------------------------------------------------- CLI path
+def test_cli_abort_exits_nonzero(tmp_path):
+    """AC: HPNN_NUMERICS=abort exits non-zero through the real CLI."""
+    _conf(tmp_path)     # writes tmp_path/samples
+    (tmp_path / "nn.conf").write_text(
+        "[name] T\n[type] ANN\n[init] generate\n[seed] 1\n"
+        "[input] 8\n[hidden] 5\n[output] 2\n[train] BP\n"
+        "[sample_dir] ./samples\n[test_dir] ./samples\n")
+    script = tmp_path / "drive.py"
+    script.write_text(textwrap.dedent("""\
+        import sys
+        sys.path.insert(0, sys.argv[2])
+        import jax.numpy as jnp
+        from hpnn_tpu.train import loop
+
+        orig = loop.train_epoch_lax
+        calls = {"n": 0}
+
+        def poisoned(w, m0, Xc, Tc, *args, **kwargs):
+            out_w, stats = orig(w, m0, Xc, Tc, *args, **kwargs)
+            calls["n"] += 1
+            if calls["n"] == 2:
+                out_w = (out_w[0].at[0, 0].set(jnp.nan),) \\
+                    + tuple(out_w[1:])
+            return out_w, stats
+
+        loop.train_epoch_lax = poisoned
+        from hpnn_tpu.cli import train_nn
+        sys.exit(train_nn.main(
+            ["--numerics", "abort", "--ledger", "led.jsonl",
+             sys.argv[1]]))
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", HPNN_FUSE_CHUNK="2",
+               HPNN_FLIGHT="flight.jsonl")
+    env.pop("HPNN_METRICS", None)
+    env.pop("HPNN_NUMERICS", None)
+    proc = subprocess.run(
+        [sys.executable, str(script), "nn.conf", ROOT],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=240)
+    assert proc.returncode != 0
+    assert "numerics sentinel abort" in proc.stderr
+    assert "Traceback" not in proc.stderr
+    # the postmortem artifacts landed: flight dump + partial ledger
+    assert (tmp_path / "flight.jsonl").exists()
+    rows = [r for r in _read(tmp_path / "led.jsonl")
+            if r["ev"] == "ledger.round"]
+    assert rows and rows[0]["nan"] == 0
+
+
+def test_run_nn_ledger_flag_writes_eval_row(tmp_path, monkeypatch):
+    """run_nn carries the same --ledger/--numerics twins: an eval run
+    appends the eval checksum row."""
+    from hpnn_tpu.cli import run_nn
+    from hpnn_tpu.train import driver
+
+    conf = _conf(tmp_path)
+    work = tmp_path / "work"
+    work.mkdir()
+    monkeypatch.chdir(work)
+    assert driver.train_kernel(conf)
+    (work / "kernel.opt").write_text("")
+    with open(work / "kernel.opt", "w") as fp:
+        from hpnn_tpu import config as config_mod
+
+        config_mod.dump_kernel(conf, fp)
+    (work / "nn.conf").write_text(
+        "[name] T\n[type] ANN\n[init] kernel.opt\n[seed] 1\n"
+        "[input] 8\n[hidden] 5\n[output] 2\n[train] BP\n"
+        f"[sample_dir] {conf.samples}\n[test_dir] {conf.tests}\n")
+    try:
+        assert run_nn.main(
+            ["--ledger", str(work / "eval.jsonl"), "--numerics", "warn",
+             "nn.conf"]) == 0
+    finally:
+        # the CLI twins write the env vars; clear them for later tests
+        probes.configure_mode(None)
+        ledger.configure(None)
+    obs._reset_for_tests()
+    rows = [r for r in _read(work / "eval.jsonl")
+            if r["ev"] == "ledger.round"]
+    assert rows and rows[-1]["where"] == "eval"
+
+
+def test_cli_rejects_bad_numerics_mode():
+    from hpnn_tpu.cli import common
+
+    assert common.validate_long_opts({"numerics": "warn"})
+    assert common.validate_long_opts({"numerics": "abort"})
+    assert not common.validate_long_opts({"numerics": "explode"})
+    assert not common.validate_long_opts({"numerics": True})
+
+
+# -------------------------------------------------------------- serve
+def test_serve_health_carries_numerics_verdict(tmp_path, monkeypatch):
+    from hpnn_tpu import serve
+
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_PROBES", "1")
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    try:
+        sess.register_kernel("k", _kernel())
+        sess.infer("k", np.zeros(8))
+        out = sess.infer("k", np.full(8, np.nan))
+        assert np.isnan(np.asarray(out)).any()
+        doc = sess.health()
+        num = doc["numerics"]
+        assert num["mode"] == "warn" and num["probes"] is True
+        kv = num["kernels"]["k"]
+        assert kv["rows"] == 2 and kv["nan"] > 0 and kv["clean"] is False
+    finally:
+        sess.close()
+    recs = _read(tmp_path / "m.jsonl")
+    nan_counts = [r for r in recs if r["ev"] == "numerics.serve_nan"]
+    assert nan_counts and nan_counts[0]["kernel"] == "k"
+
+
+def test_serve_health_numerics_off_by_default(tmp_path, monkeypatch):
+    from hpnn_tpu import serve
+
+    for knob in ("HPNN_PROBES", "HPNN_NUMERICS", "HPNN_LEDGER"):
+        monkeypatch.delenv(knob, raising=False)
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    try:
+        sess.register_kernel("k", _kernel())
+        sess.infer("k", np.full(8, np.nan))     # census not armed
+        assert sess.health()["numerics"] == {"mode": "off"}
+    finally:
+        sess.close()
+
+
+# ------------------------------------------------------ export plumbing
+def test_probe_gauges_reach_export(tmp_path, monkeypatch):
+    from hpnn_tpu.obs import export
+
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_PROBES", "1")
+    obs._reset_for_tests()
+    probes.check_weights(_kernel().weights, step=1, where="unit")
+    snap = obs.snapshot_state()
+    assert "numerics.absmax" in snap["gauges"]
+    assert snap["gauges"]["numerics.nan_count"] == 0
+    body = export.render_prometheus(snap)
+    assert "hpnn_numerics_absmax" in body
+    health = export.health()
+    assert health["numerics"]["clean"] is True
+    assert health["numerics"]["where"] == "unit"
+
+
+def test_obs_report_numerics_section(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_NUMERICS", "warn")
+    obs._reset_for_tests()
+    k = _kernel()
+    probes.check_weights(k.weights, step=1, where="unit")
+    bad = (np.asarray(k.weights[0]).copy(),) + tuple(k.weights[1:])
+    bad[0][0, 0] = np.nan
+    probes.check_weights(bad, step=2, where="unit")
+    obs.flush()
+    rep_mod = _load_tool("obs_report")
+    rep = rep_mod.summarize(_read(tmp_path / "m.jsonl"))
+    assert rep["numerics"]["checks"] == 2
+    assert len(rep["numerics"]["alerts"]) == 1
+    assert rep["numerics"]["alerts"][0]["ev"] == "numerics.nan"
+    text = rep_mod.render(rep)
+    assert "-- numerics --" in text
+    assert "ALERT numerics.nan" in text
+
+
+def test_configure_twins(tmp_path, monkeypatch):
+    monkeypatch.delenv("HPNN_NUMERICS", raising=False)
+    monkeypatch.delenv("HPNN_LEDGER", raising=False)
+    obs._reset_for_tests()
+    probes.configure_mode("abort")
+    assert probes.mode() == "abort"
+    ledger.configure(str(tmp_path / "led.jsonl"))
+    assert ledger.enabled()
+    assert probes.enabled()     # the ledger alone arms the checks
+    probes.configure_mode(None)
+    ledger.configure(None)
+    assert not ledger.enabled()
+    assert probes.mode() == "off"

@@ -56,6 +56,80 @@ def test_lint_detects_an_undocumented_name(tmp_path):
     assert mod.check(str(tmp_path)) == []
 
 
+def _write_ledger(path, rows):
+    import json
+
+    with open(path, "w") as fp:
+        fp.write(json.dumps({"ts": 0, "ev": "ledger.open",
+                             "path": str(path), "pid": 1, "rank": 0})
+                 + "\n")
+        for rec in rows:
+            fp.write(json.dumps(rec) + "\n")
+
+
+def _round(row, **over):
+    rec = {"ts": 0.0, "ev": "ledger.round", "row": row, "step": row + 1,
+           "where": "fused_chunk", "rank": 0, "nan": 0, "inf": 0,
+           "checksums": {"w0": 12.5, "w1": 3.25},
+           "shapes": {"w0": [5, 8], "w1": [2, 5]}}
+    rec.update(over)
+    return rec
+
+
+def test_ledger_lint_accepts_a_well_formed_ledger(tmp_path):
+    mod = _load()
+    path = tmp_path / "ledger.jsonl"
+    _write_ledger(path, [_round(0), _round(1), _round(2)])
+    assert mod.lint_ledger(str(path)) == []
+
+
+def test_ledger_lint_catches_every_schema_break(tmp_path):
+    """Each frozen-contract clause bites: missing key, broken row
+    monotonicity, shapes/checksums key mismatch, non-numeric checksum,
+    bad shape entries, negative censuses, and a wrong header."""
+    mod = _load()
+    path = tmp_path / "ledger.jsonl"
+
+    bad = _round(0)
+    del bad["where"]
+    _write_ledger(path, [bad])
+    assert any("missing keys" in f for f in mod.lint_ledger(str(path)))
+
+    _write_ledger(path, [_round(0), _round(5)])
+    assert any("not monotone" in f for f in mod.lint_ledger(str(path)))
+
+    _write_ledger(path, [_round(0, shapes={"w0": [5, 8]})])
+    assert any("shapes keys" in f for f in mod.lint_ledger(str(path)))
+
+    _write_ledger(path, [_round(0, checksums={"w0": True, "w1": 1.0})])
+    assert mod.lint_ledger(str(path))  # bool is not a checksum number
+
+    _write_ledger(path, [_round(0, shapes={"w0": [5, 0], "w1": [2, 5]})])
+    assert mod.lint_ledger(str(path))  # non-positive dim
+
+    _write_ledger(path, [_round(0, nan=-1)])
+    assert any("nan census" in f for f in mod.lint_ledger(str(path)))
+
+    import json
+
+    with open(path, "w") as fp:
+        fp.write(json.dumps(_round(0)) + "\n")   # no ledger.open header
+    assert any("ledger.open" in f for f in mod.lint_ledger(str(path)))
+
+    assert mod.lint_ledger(str(tmp_path / "missing.jsonl"))  # unreadable
+
+
+def test_main_ledger_flag_exit_codes(tmp_path, capsys):
+    mod = _load()
+    path = tmp_path / "ledger.jsonl"
+    _write_ledger(path, [_round(0)])
+    assert mod.main(["--ledger", str(path)]) == 0
+    _write_ledger(path, [_round(3)])
+    assert mod.main(["--ledger", str(path)]) == 1
+    assert mod.main(["--ledger"]) == 2
+    capsys.readouterr()
+
+
 def test_call_site_regex_matches_every_emitter_style(tmp_path):
     """obs.timer / bare event() / raw {"ev": ...} records all count."""
     mod = _load()

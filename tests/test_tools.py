@@ -119,6 +119,60 @@ def test_pdif_skips_mo_radiation(tmp_path, capsys):
     assert not (tmp_path / "samples" / "R000002").exists()
 
 
+def test_pdif_json_mode(tmp_path, capsys):
+    """--json captures the whole text protocol and emits one report
+    document: params, written/skipped censuses, the buffered stdout."""
+    import json
+
+    (tmp_path / "rruff" / "dif").mkdir(parents=True)
+    (tmp_path / "rruff" / "raw").mkdir()
+    (tmp_path / "rruff" / "dif" / "R000001").write_text(DIF_TEXT)
+    (tmp_path / "rruff" / "raw" / "R000001").write_text(RAW_TEXT)
+    # a second file that trips the Mo-radiation skip
+    (tmp_path / "rruff" / "dif" / "R000002").write_text(
+        DIF_TEXT.replace("1.541838", "0.710730"))
+    (tmp_path / "rruff" / "raw" / "R000002").write_text(RAW_TEXT)
+    (tmp_path / "samples").mkdir()
+    assert pdif.main(
+        [str(tmp_path / "rruff"), "--json", "-i", "4", "-o", "230",
+         "-s", str(tmp_path / "samples")]
+    ) == 0
+    out = capsys.readouterr().out
+    report = json.loads(out)          # exactly one JSON document
+    assert report["ok"] is True and report["exit_code"] == 0
+    # n_inputs is the effective count: 4 spectrum bins + temperature
+    assert report["params"] == {
+        "rruff_dir": str(tmp_path / "rruff"), "n_inputs": 5,
+        "n_outputs": 230, "sample_dir": str(tmp_path / "samples")}
+    assert report["written"] == ["R000001"]
+    assert report["skipped"] == [
+        {"file": "R000002", "reason": "mo_radiation"}]
+    # the text protocol was captured, not printed
+    assert out.count("\n") == 1
+    assert any(">> received:" in ln for ln in report["stdout_lines"])
+    # the written sample is byte-identical to a plain-mode run
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    assert pdif.main(
+        [str(tmp_path / "rruff"), "-i", "4", "-o", "230",
+         "-s", str(plain)]
+    ) == 0
+    capsys.readouterr()
+    assert (plain / "R000001").read_bytes() == \
+        (tmp_path / "samples" / "R000001").read_bytes()
+
+
+def test_pdif_json_reports_failure(tmp_path, capsys):
+    import json
+
+    assert pdif.main(
+        ["--json", str(tmp_path / "nowhere"), "-i", "4", "-o", "230",
+         "-s", str(tmp_path / "nowhere")]
+    ) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is False and report["exit_code"] == 1
+
+
 def test_gen_ann_loadable(tmp_path, capsys):
     assert gen_ann.main(["--seed", "42", "8", "6", "4"]) == 0
     text = capsys.readouterr().out

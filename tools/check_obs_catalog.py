@@ -20,9 +20,15 @@ Dynamic names (a variable first argument) are invisible to the grep —
 the emitting style in this repo is literal-names-only precisely so
 this lint stays sound.
 
+The tool also carries the checksum-ledger schema lint
+(:func:`lint_ledger`): the ledger (``HPNN_LEDGER``,
+hpnn_tpu/obs/ledger.py) is a comparison artifact with a FROZEN row
+schema — ``tools/ledger_diff.py`` and external tooling parse it — so
+any drift is a contract break, not a cosmetic change.
+
 Run standalone (exit code for CI)::
 
-    python tools/check_obs_catalog.py
+    python tools/check_obs_catalog.py [--ledger PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -115,9 +121,98 @@ def check(root: str) -> list[str]:
     return failures
 
 
-def main() -> int:
+# the frozen ledger.round row contract (obs/ledger.py docstring)
+LEDGER_REQUIRED = {"ts", "ev", "row", "step", "where", "rank", "nan",
+                   "inf", "checksums", "shapes"}
+
+
+def lint_ledger(path: str) -> list[str]:
+    """Schema-lint one checksum-ledger file; returns failure strings.
+
+    Checks: every line is a JSON object; the first is a ``ledger.open``
+    header carrying path/pid/rank; every ``ledger.round`` row has the
+    required keys, a ``row`` index monotone from 0, name→number
+    checksums and name→shape-list shapes over the SAME tensor set, and
+    non-negative integer nan/inf censuses."""
+    import json
+
+    failures = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read ledger {path!r}: {exc}"]
+    if not lines:
+        return [f"ledger {path!r} is empty"]
+    recs = []
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError as exc:
+            failures.append(f"line {i + 1}: not JSON ({exc})")
+            continue
+        if not isinstance(rec, dict):
+            failures.append(f"line {i + 1}: not a JSON object")
+            continue
+        recs.append(rec)
+    if recs and recs[0].get("ev") != "ledger.open":
+        failures.append(
+            f"first record is {recs[0].get('ev')!r}, want 'ledger.open'")
+    elif recs:
+        for key in ("ts", "path", "pid", "rank"):
+            if key not in recs[0]:
+                failures.append(f"ledger.open header missing {key!r}")
+    next_row = 0
+    for i, rec in enumerate(recs):
+        if rec.get("ev") != "ledger.round":
+            continue
+        at = f"record {i + 1}"
+        missing = LEDGER_REQUIRED - set(rec)
+        if missing:
+            failures.append(f"{at}: missing keys {sorted(missing)}")
+            continue
+        if rec["row"] != next_row:
+            failures.append(
+                f"{at}: row {rec['row']!r} not monotone (want {next_row})")
+        else:
+            next_row += 1
+        cs, sh = rec["checksums"], rec["shapes"]
+        if not isinstance(cs, dict) or not cs:
+            failures.append(f"{at}: checksums is not a non-empty object")
+            continue
+        if not isinstance(sh, dict) or set(sh) != set(cs):
+            failures.append(
+                f"{at}: shapes keys {sorted(sh) if isinstance(sh, dict) else sh!r} "
+                f"!= checksums keys {sorted(cs)}")
+        for name, v in cs.items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                failures.append(f"{at}: checksum {name!r} is not a number")
+        if isinstance(sh, dict):
+            for name, v in sh.items():
+                if (not isinstance(v, list) or not v
+                        or not all(isinstance(d, int) and d >= 1
+                                   for d in v)):
+                    failures.append(
+                        f"{at}: shape {name!r} is not a list of "
+                        "positive ints")
+        for census in ("nan", "inf"):
+            v = rec[census]
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                failures.append(
+                    f"{at}: {census} census is not a non-negative int")
+    return failures
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     failures = check(root)
+    if "--ledger" in argv:
+        i = argv.index("--ledger")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --ledger needs a path\n")
+            return 2
+        failures += lint_ledger(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

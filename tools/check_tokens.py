@@ -17,10 +17,15 @@ unset, once with it pointed at a JSONL sink — and asserts
    events, device telemetry).
 
 The instrumented run enables the whole surface at once — JSONL sink,
-flight-recorder ring (``HPNN_FLIGHT``), device telemetry, and a live
-export server whose ``/metrics`` endpoint is scraped inside the
-capture window — so "byte-frozen" is proven against the maximal
-configuration, not the minimal one.
+flight-recorder ring (``HPNN_FLIGHT``), device telemetry, numerics
+probes + sentinel + checksum ledger (``HPNN_PROBES`` /
+``HPNN_NUMERICS`` / ``HPNN_LEDGER``), and a live export server whose
+``/metrics`` endpoint is scraped inside the capture window — so
+"byte-frozen" is proven against the maximal configuration, not the
+minimal one.  A final ledger-only run proves the probes are
+zero-perturbation: its checksum ledger must equal the probed run's
+row for row (equal abs-sums on the f64 CPU parity path mean equal
+weights — enabling probes did not move the trajectory).
 
 Run standalone (exit code for CI)::
 
@@ -137,17 +142,24 @@ def check(tmpdir: str) -> list[str]:
         finally:
             export.stop_export_server(server)
 
+    ledger_b = os.path.join(tmpdir, "ledger_b.jsonl")
     os.environ["HPNN_FLIGHT"] = os.path.join(tmpdir, "flight.jsonl")
+    os.environ["HPNN_PROBES"] = "1"
+    os.environ["HPNN_NUMERICS"] = "warn"
+    os.environ["HPNN_LEDGER"] = ledger_b
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
     finally:
-        os.environ.pop("HPNN_FLIGHT", None)
+        for knob in ("HPNN_FLIGHT", "HPNN_PROBES", "HPNN_NUMERICS",
+                     "HPNN_LEDGER"):
+            os.environ.pop(knob, None)
 
     if plain != instrumented:
         failures.append(
             "stdout is NOT byte-identical with HPNN_METRICS + "
-            "HPNN_FLIGHT + export server all enabled "
+            "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
+            "export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
     body = scraped.get("metrics", "")
     if "# TYPE" not in body or "hpnn_" not in body:
@@ -188,6 +200,44 @@ def check(tmpdir: str) -> list[str]:
             f"hpnn_tpu.serve (plain {len(plain)}B vs "
             f"with-serve {len(with_serve)}B)")
 
+    # The zero-perturbation proof for the numerics probes: a run with
+    # ONLY the ledger on (no probes, no metrics) must print the same
+    # bytes AND record the same checksums as the fully-probed run b —
+    # the probes' stats dispatch is a separate executable, so enabling
+    # it cannot move the training trajectory (f64 CPU runs of the same
+    # seed are bit-identical; equal abs-sums here mean equal weights).
+    ledger_d = os.path.join(tmpdir, "ledger_d.jsonl")
+    os.environ["HPNN_LEDGER"] = ledger_d
+    try:
+        ledger_only = _run_round(os.path.join(tmpdir, "d"), None)
+    finally:
+        os.environ.pop("HPNN_LEDGER", None)
+    if plain != ledger_only:
+        failures.append(
+            "stdout is NOT byte-identical with HPNN_LEDGER enabled "
+            f"(plain {len(plain)}B vs ledger-only {len(ledger_only)}B)")
+
+    def _rounds(path):
+        if not os.path.exists(path):
+            return None
+        with open(path) as fp:
+            return [
+                {k: rec[k] for k in ("row", "step", "where", "nan",
+                                     "inf", "checksums", "shapes")}
+                for rec in (json.loads(ln) for ln in fp if ln.strip())
+                if rec.get("ev") == "ledger.round"
+            ]
+
+    rounds_b, rounds_d = _rounds(ledger_b), _rounds(ledger_d)
+    if not rounds_b or not rounds_d:
+        failures.append(
+            f"ledger missing or empty (b={rounds_b and len(rounds_b)}, "
+            f"d={rounds_d and len(rounds_d)})")
+    elif rounds_b != rounds_d:
+        failures.append(
+            "probes are NOT zero-perturbation: probed ledger differs "
+            f"from ledger-only ledger ({rounds_b} vs {rounds_d})")
+
     if not os.path.exists(sink):
         failures.append("instrumented run produced no metrics sink")
         return failures
@@ -198,7 +248,8 @@ def check(tmpdir: str) -> list[str]:
     names = {r.get("ev") for r in recs}
     for want in ("round.start", "driver.chunk_dispatch", "train.n_iter",
                  "fuse.chunk_size", "round.end", "obs.summary",
-                 "device.live_arrays"):
+                 "device.live_arrays", "numerics.probe",
+                 "numerics.checksum"):
         if want not in names:
             failures.append(f"metrics sink missing event {want!r}")
     return failures

@@ -1,0 +1,198 @@
+#!/usr/bin/env python3
+"""Compare two checksum ledgers under the reference tolerances.
+
+The ledger (``HPNN_LEDGER``, hpnn_tpu/obs/ledger.py) records the
+abs-sum of every weight tensor once per numerics check.  This tool
+diffs two such files row by row and reports whether the runs agree
+under the reference library's cross-backend consistency criterion:
+absolute sums equal to **1e-14 for vectors** and **1e-12 for weight
+matrices** (reference ChangeLog:33-38 — the CUDA-port validation
+note).  The tensor's recorded shape picks its tolerance: a tensor with
+at least two dims of extent > 1 is a matrix.
+
+Usage::
+
+    python tools/ledger_diff.py A.jsonl B.jsonl [--json]
+        [--vec-tol 1e-14] [--mat-tol 1e-12]
+
+Rows are paired by their ``row`` index (both ledgers auto-increment
+from 0), never by timestamp.  A row present in only one ledger, a NaN
+checksum, a ``nan``/``inf`` census > 0, or a shape/tensor-set mismatch
+all count as divergence.  Exit status: 0 clean, 1 divergent, 2 usage
+or I/O error.  ``--json`` prints one machine-readable report document
+instead of text (for CI, like ``pdif --json``).
+
+Deliberately stdlib-only and self-contained (no hpnn_tpu import): it
+must run on a login node or in CI against ledgers scp'd from anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+VEC_TOL = 1e-14
+MAT_TOL = 1e-12
+
+
+def tolerance_for(shape) -> float:
+    """1e-14 for vector-like tensors, 1e-12 for real matrices — the
+    same rule as hpnn_tpu/obs/probes.py (duplicated on purpose: this
+    file must not import the package)."""
+    dims = [int(d) for d in shape]
+    if len([d for d in dims if d > 1]) >= 2:
+        return MAT_TOL
+    return VEC_TOL
+
+
+def load_rounds(path: str) -> list[dict]:
+    """The ``ledger.round`` rows of one ledger file, in file order."""
+    rows = []
+    with open(path) as fp:
+        for ln in fp:
+            ln = ln.strip()
+            if not ln:
+                continue
+            rec = json.loads(ln)
+            if rec.get("ev") == "ledger.round":
+                rows.append(rec)
+    return rows
+
+
+def compare(rows_a: list[dict], rows_b: list[dict], *,
+            vec_tol: float = VEC_TOL, mat_tol: float = MAT_TOL) -> dict:
+    """Pairwise row comparison; returns the report dict."""
+    divergent = []
+    max_abs_diff = 0.0
+    n = min(len(rows_a), len(rows_b))
+    if len(rows_a) != len(rows_b):
+        divergent.append({
+            "row": None,
+            "tensor": None,
+            "reason": "row_count",
+            "detail": f"{len(rows_a)} rows vs {len(rows_b)} rows",
+        })
+    for i in range(n):
+        ra, rb = rows_a[i], rows_b[i]
+        ca, cb = ra.get("checksums", {}), rb.get("checksums", {})
+        if set(ca) != set(cb):
+            divergent.append({
+                "row": i, "tensor": None, "reason": "tensor_set",
+                "detail": f"{sorted(ca)} vs {sorted(cb)}",
+            })
+            continue
+        sa, sb = ra.get("shapes", {}), rb.get("shapes", {})
+        for name in sorted(ca):
+            if sa.get(name) != sb.get(name):
+                divergent.append({
+                    "row": i, "tensor": name, "reason": "shape",
+                    "detail": f"{sa.get(name)} vs {sb.get(name)}",
+                })
+                continue
+            va, vb = float(ca[name]), float(cb[name])
+            if math.isnan(va) or math.isnan(vb):
+                divergent.append({
+                    "row": i, "tensor": name, "reason": "nan_checksum",
+                    "a": va, "b": vb,
+                })
+                continue
+            shape = sa.get(name) or sb.get(name) or []
+            tol = mat_tol if tolerance_for(shape) == MAT_TOL else vec_tol
+            diff = abs(va - vb)
+            max_abs_diff = max(max_abs_diff, diff)
+            if diff > tol:
+                divergent.append({
+                    "row": i, "tensor": name, "reason": "tolerance",
+                    "a": va, "b": vb, "diff": diff, "tol": tol,
+                })
+        for census in ("nan", "inf"):
+            bad = int(ra.get(census, 0)) + int(rb.get(census, 0))
+            if bad:
+                divergent.append({
+                    "row": i, "tensor": None, "reason": f"{census}_census",
+                    "detail": f"{bad} non-finite values recorded",
+                })
+    return {
+        "rows_a": len(rows_a),
+        "rows_b": len(rows_b),
+        "compared": n,
+        "vec_tol": vec_tol,
+        "mat_tol": mat_tol,
+        "max_abs_diff": max_abs_diff,
+        "divergent": divergent,
+        "clean": not divergent,
+    }
+
+
+def _render_text(report: dict, path_a: str, path_b: str) -> str:
+    lines = [f"ledger_diff: {path_a} vs {path_b}",
+             f"  rows: {report['rows_a']} vs {report['rows_b']} "
+             f"({report['compared']} compared)",
+             f"  tolerances: vec={report['vec_tol']:.0e} "
+             f"mat={report['mat_tol']:.0e}",
+             f"  max |a-b|: {report['max_abs_diff']:.3e}"]
+    for d in report["divergent"]:
+        where = f"row {d['row']}" if d.get("row") is not None else "global"
+        name = d.get("tensor") or "-"
+        if d["reason"] == "tolerance":
+            lines.append(
+                f"  DIVERGENT {where} {name}: |{d['a']!r} - {d['b']!r}| "
+                f"= {d['diff']:.3e} > {d['tol']:.0e}")
+        else:
+            lines.append(
+                f"  DIVERGENT {where} {name}: {d['reason']} "
+                f"({d.get('detail', '')})".rstrip(" ()"))
+    lines.append("  verdict: " + ("CLEAN" if report["clean"]
+                                  else "DIVERGENT"))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    if as_json:
+        argv.remove("--json")
+    vec_tol, mat_tol = VEC_TOL, MAT_TOL
+    paths = []
+    i = 0
+    try:
+        while i < len(argv):
+            a = argv[i]
+            if a == "--vec-tol":
+                vec_tol = float(argv[i + 1])
+                i += 2
+            elif a == "--mat-tol":
+                mat_tol = float(argv[i + 1])
+                i += 2
+            elif a.startswith("-"):
+                raise IndexError(a)
+            else:
+                paths.append(a)
+                i += 1
+    except (IndexError, ValueError):
+        sys.stderr.write("ledger_diff: bad arguments\n")
+        return 2
+    if len(paths) != 2:
+        sys.stderr.write(
+            "usage: ledger_diff.py A.jsonl B.jsonl [--json] "
+            "[--vec-tol X] [--mat-tol Y]\n")
+        return 2
+    try:
+        rows_a = load_rounds(paths[0])
+        rows_b = load_rounds(paths[1])
+    except (OSError, json.JSONDecodeError) as exc:
+        sys.stderr.write(f"ledger_diff: cannot read ledger: {exc}\n")
+        return 2
+    report = compare(rows_a, rows_b, vec_tol=vec_tol, mat_tol=mat_tol)
+    if as_json:
+        report["a"] = paths[0]
+        report["b"] = paths[1]
+        sys.stdout.write(json.dumps(report) + "\n")
+    else:
+        sys.stdout.write(_render_text(report, paths[0], paths[1]))
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,6 +112,11 @@ def summarize(events: list[dict]) -> dict:
         "fallback_log": [],     # ordered fallback/resume/halving records
         "summary": None,        # LAST obs.summary record (cumulative)
         "rounds": [],           # round.start/round.end/eval.round events
+        "numerics": {           # numerics.* probe/sentinel digest
+            "checks": 0,
+            "last_checksums": None,
+            "alerts": [],       # numerics.nan / numerics.divergence
+        },
     }
     for rec in events:
         ev = rec.get("ev", "?")
@@ -144,6 +149,11 @@ def summarize(events: list[dict]) -> dict:
             rep["events"][ev] = rep["events"].get(ev, 0) + 1
             if ev.startswith(("round.", "eval.")):
                 rep["rounds"].append(rec)
+            elif ev == "numerics.checksum":
+                rep["numerics"]["checks"] += 1
+                rep["numerics"]["last_checksums"] = rec.get("checksums")
+            elif ev in ("numerics.nan", "numerics.divergence"):
+                rep["numerics"]["alerts"].append(rec)
         if ev.startswith(_FALLBACK_EVS[0]) or ev in _FALLBACK_EVS[1:]:
             rep["fallback_log"].append(rec)
     for t in rep["timers"].values():
@@ -235,6 +245,22 @@ def render(rep: dict) -> str:
             flag = f"  FAILED({c['failed']})" if c.get("failed") else ""
             w(f"  {str(c['done']):>8s} {str(c['size']):>6s}"
               f" {str(c['body']):>7s} {c['dt']:9.4f}{flag}")
+    num = rep.get("numerics") or {}
+    if num.get("checks") or num.get("alerts"):
+        w("")
+        w("-- numerics --")
+        w(f"  checks: {num.get('checks', 0)}"
+          f"   alerts: {len(num.get('alerts', []))}")
+        cs = num.get("last_checksums")
+        if cs:
+            w("  last checksums (abs-sums):")
+            for name, v in sorted(cs.items()):
+                w(f"    {name:8s} {v!r}")
+        for rec in num.get("alerts", []):
+            fields = {k: v for k, v in rec.items()
+                      if k not in ("ts", "ev", "kind", "detail")}
+            w(f"  ALERT {rec['ev']}: " + ", ".join(
+                f"{k}={v}" for k, v in fields.items()))
     if rep["fallback_log"]:
         w("")
         w("-- fallback / resume log (emission order) --")
