@@ -739,6 +739,32 @@ def main(argv=None) -> None:
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:
         compact["obs_metrics_file"] = out["obs_metrics_file"]
+
+    # Trajectory file for tools/bench_gate.py: one line per run, the
+    # compact summary stamped with when/what ran.  Append-only JSONL so
+    # a torn write can only cost its own line; best-effort like every
+    # other side channel here.  HPNN_BENCH_HISTORY= (empty) disables.
+    history_path = os.environ.get("HPNN_BENCH_HISTORY",
+                                  "bench_history.jsonl")
+    if history_path:
+        entry = dict(compact)
+        entry["when"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            entry["git_sha"] = (sha.stdout.strip()
+                                if sha.returncode == 0 else None)
+        except (OSError, subprocess.SubprocessError):
+            entry["git_sha"] = None
+        try:
+            with open(history_path, "a") as fp:
+                fp.write(json.dumps(entry) + "\n")
+        except OSError as exc:
+            print(f"bench: can't append {history_path}: {exc}",
+                  file=sys.stderr)
+
     print(json.dumps(compact))
 
 

@@ -24,7 +24,14 @@ structured side channel next to it:
   a per-round checksum ledger gated by ``HPNN_LEDGER=<path>``
   (obs/ledger.py, diff tool: tools/ledger_diff.py), and a cross-rank
   divergence sentinel under the reference 1e-14/1e-12 tolerances —
-  ``HPNN_PROBES`` / ``HPNN_NUMERICS=warn|abort`` (obs/probes.py).
+  ``HPNN_PROBES`` / ``HPNN_NUMERICS=warn|abort`` (obs/probes.py);
+* performance attribution: parent/child latency spans threaded through
+  the serve request lifecycle and train rounds — ``HPNN_SPANS``
+  (obs/spans.py, tree renderer: ``tools/obs_report.py --spans``) —
+  and compiled-cost introspection (FLOPs/bytes per executable via the
+  AOT ``cost_analysis``/``memory_analysis`` surface) feeding
+  ``perf.flops_per_s`` / ``perf.mfu`` / ``perf.bytes_per_s`` gauges —
+  ``HPNN_COST`` (obs/cost.py; regression gate: tools/bench_gate.py).
 
 Typical instrumentation site::
 
@@ -38,7 +45,8 @@ Typical instrumentation site::
 Event-name catalog and schema: docs/observability.md.
 """
 
-from hpnn_tpu.obs import device, export, flight, ledger, probes
+from hpnn_tpu.obs import (cost, device, export, flight, ledger, probes,
+                          spans)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -62,6 +70,7 @@ __all__ = [
     "activate_memory",
     "annotate",
     "configure",
+    "cost",
     "count",
     "device",
     "enabled",
@@ -75,6 +84,7 @@ __all__ = [
     "probes",
     "sink_path",
     "snapshot_state",
+    "spans",
     "step_annotation",
     "summary",
     "timer",

@@ -1,0 +1,253 @@
+"""Compiled-cost introspection + MFU gauges (the ``HPNN_COST`` knob).
+
+XLA already knows what every executable we own costs — FLOPs, bytes
+touched, temp/argument/output buffer sizes — through the AOT
+introspection surface ``jit(f).lower(*args).compile()`` →
+``.cost_analysis()`` / ``.memory_analysis()``.  This module turns that
+into the obs side channel's attribution story:
+
+* one ``compile.cost`` event per executable identity (the **cost
+  catalog**): FLOPs, bytes accessed, temp/arg/output/code bytes, and
+  compile wall time, tagged with the executable's name and any caller
+  metadata (kernel, bucket, body, ...);
+* ``perf.flops_per_s`` / ``perf.bytes_per_s`` / ``perf.mfu`` gauges,
+  produced by :func:`record_dispatch` from a measured dispatch wall
+  time and the cataloged static cost — these flow into the registry
+  aggregates and out on ``GET /metrics`` as ``hpnn_perf_flops_per_s``
+  etc.
+
+Three entry points, by what the caller holds:
+
+* :func:`note_executable` — an already-compiled AOT executable (the
+  serve engine's bucket entries): read its analyses, **zero** extra
+  compiles;
+* :func:`analyze_jitted` — a ``jax.jit`` wrapper plus example args
+  (the train drivers): pays ONE extra lower+compile purely for
+  introspection, so it runs once per executable identity and only when
+  the knob is on (the documented overhead of ``HPNN_COST``);
+* :func:`analyze_fn` — a bare callable; jits it first.
+
+Every entry point is guarded: an executable whose closure cannot be
+retraced (e.g. host-side numpy padding in the TP epoch) records a
+``compile.cost`` event with an ``error`` field instead of raising —
+cost introspection must never take down a training round.
+
+MFU is ``flops_per_s / peak_flops`` where the peak comes from
+``HPNN_PEAK_FLOPS`` (float, FLOP/s) or a per-backend nominal default —
+the v5e bf16 peak on TPU (matching bench.py), **indicative-only**
+numbers elsewhere: on CPU the gauge is a relative trend signal for the
+dashboards, not a true utilization (docs/observability.md spells out
+the caveat).
+
+Contract: ``HPNN_COST`` unset ⇒ one env read ever, then constant-time
+no-ops; no stdout bytes; no extra compiles; the traced graphs of the
+real train/serve steps are never altered (introspection compiles are
+separate executables).  ``tools/check_tokens.py`` proves the byte
+freeze and ledger identity with cost introspection ON.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from hpnn_tpu.obs import registry
+
+ENV_KNOB = "HPNN_COST"
+PEAK_ENV = "HPNN_PEAK_FLOPS"
+
+# MFU denominators when HPNN_PEAK_FLOPS is unset.  TPU: the v5e bf16
+# peak bench.py reports against; others are nominal, indicative-only.
+_DEFAULT_PEAK = {"tpu": 394e12, "gpu": 100e12, "cpu": 100e9}
+
+_enabled: bool | None = None
+_peak: float | None = None
+_lock = threading.Lock()
+# executable name -> {"flops", "bytes", "units"}; an entry with None
+# costs marks "analysis attempted, unavailable" so we never retry per
+# dispatch
+_catalog: dict[str, dict] = {}
+
+
+def enabled() -> bool:
+    """True when ``HPNN_COST`` is set.  First call reads the env;
+    later calls are a memo hit."""
+    global _enabled
+    if _enabled is None:
+        _enabled = bool(os.environ.get(ENV_KNOB))
+    return _enabled
+
+
+def peak_flops() -> float:
+    """The MFU denominator: ``HPNN_PEAK_FLOPS`` or the backend
+    nominal."""
+    global _peak
+    if _peak is None:
+        try:
+            v = float(os.environ.get(PEAK_ENV, ""))
+        except ValueError:
+            v = 0.0
+        if v <= 0.0:
+            backend = "cpu"
+            try:
+                import jax
+
+                backend = jax.default_backend()
+            except Exception:
+                pass
+            v = _DEFAULT_PEAK.get(backend, _DEFAULT_PEAK["cpu"])
+        _peak = v
+    return _peak
+
+
+def catalog() -> dict[str, dict]:
+    """A copy of the cost catalog built so far (test/report surface)."""
+    with _lock:
+        return {k: dict(v) for k, v in _catalog.items()}
+
+
+def _first(analysis):
+    # jax returns the cost analysis as a dict on some versions and a
+    # one-element list of dicts on others (one per computation)
+    if isinstance(analysis, (list, tuple)):
+        return analysis[0] if analysis else {}
+    return analysis or {}
+
+
+def _emit_cost(name: str, rec: dict) -> None:
+    st = registry._active()
+    if st is None:
+        return
+    out = {"ev": "compile.cost", "kind": "event", "exe": name}
+    out.update(rec)
+    registry._emit(st, out)
+
+
+def note_executable(name: str, compiled, units: int = 1,
+                    compile_s: float | None = None, **meta) -> None:
+    """Catalog an already-compiled AOT executable (no extra compile).
+
+    ``units`` is the per-dispatch work quantum the analysis covers
+    (rows for serve buckets, chunk samples for the fused step) —
+    :func:`record_dispatch` scales the static cost by its own units
+    against this baseline.  First call per ``name`` wins; later calls
+    are no-ops.  Never raises.
+    """
+    if not enabled():
+        return
+    with _lock:
+        if name in _catalog:
+            return
+        entry = _catalog[name] = {
+            "flops": None, "bytes": None, "units": max(int(units), 1)}
+    rec = dict(meta)
+    rec["units"] = entry["units"]
+    try:
+        ca = _first(compiled.cost_analysis())
+        flops = ca.get("flops")
+        byts = ca.get("bytes accessed")
+        if flops is not None:
+            entry["flops"] = rec["flops"] = float(flops)
+        if byts is not None:
+            entry["bytes"] = rec["bytes_accessed"] = float(byts)
+    except Exception as exc:
+        rec["error"] = type(exc).__name__
+    try:
+        mem = compiled.memory_analysis()
+        for key, attr in (("temp_bytes", "temp_size_in_bytes"),
+                          ("arg_bytes", "argument_size_in_bytes"),
+                          ("out_bytes", "output_size_in_bytes"),
+                          ("code_bytes", "generated_code_size_in_bytes")):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                rec[key] = int(v)
+    except Exception:
+        pass
+    if compile_s is not None:
+        rec["compile_s"] = round(float(compile_s), 6)
+    _emit_cost(name, rec)
+
+
+def analyze_jitted(name: str, jitted, *args, units: int = 1,
+                   **meta) -> None:
+    """Catalog a ``jax.jit`` wrapper by compiling it once for
+    introspection (the one documented overhead of ``HPNN_COST``; the
+    executable actually dispatched is untouched).  Never raises — a
+    closure that cannot be retraced records an ``error`` entry."""
+    if not enabled():
+        return
+    with _lock:
+        if name in _catalog:
+            return
+    try:
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*args).compile()
+        compile_s = time.perf_counter() - t0
+    except Exception as exc:
+        with _lock:
+            if name in _catalog:
+                return
+            _catalog[name] = {"flops": None, "bytes": None,
+                              "units": max(int(units), 1)}
+        rec = dict(meta)
+        rec["units"] = max(int(units), 1)
+        rec["error"] = type(exc).__name__
+        _emit_cost(name, rec)
+        return
+    note_executable(name, compiled, units=units, compile_s=compile_s,
+                    **meta)
+
+
+def analyze_fn(name: str, fn, *args, units: int = 1, **meta) -> None:
+    """Catalog a bare callable: jit + :func:`analyze_jitted`."""
+    if not enabled():
+        return
+    with _lock:
+        if name in _catalog:
+            return
+    try:
+        import jax
+
+        jitted = jax.jit(fn)
+    except Exception as exc:
+        with _lock:
+            if name in _catalog:
+                return
+            _catalog[name] = {"flops": None, "bytes": None,
+                              "units": max(int(units), 1)}
+        _emit_cost(name, {"units": max(int(units), 1),
+                          "error": type(exc).__name__, **meta})
+        return
+    analyze_jitted(name, jitted, *args, units=units, **meta)
+
+
+def record_dispatch(name: str, dt: float,
+                    units: int | None = None) -> None:
+    """Combine one measured dispatch wall time with the cataloged
+    static cost into the ``perf.*`` gauges.  ``units`` scales the
+    cataloged cost when this dispatch did a different amount of work
+    than the analyzed one (a shrunken chunk); omitted = the analyzed
+    quantum.  Unknown name / no cost / non-positive dt: no-op."""
+    if not enabled() or not dt or dt <= 0.0:
+        return
+    with _lock:
+        entry = _catalog.get(name)
+        if entry is None:
+            return
+        flops, byts, base = entry["flops"], entry["bytes"], entry["units"]
+    scale = (max(int(units), 1) / base) if units is not None else 1.0
+    if flops:
+        fps = flops * scale / dt
+        registry.gauge("perf.flops_per_s", fps, exe=name)
+        registry.gauge("perf.mfu", fps / peak_flops(), exe=name)
+    if byts:
+        registry.gauge("perf.bytes_per_s", byts * scale / dt, exe=name)
+
+
+def _reset_for_tests() -> None:
+    global _enabled, _peak
+    with _lock:
+        _enabled = None
+        _peak = None
+        _catalog.clear()

@@ -79,7 +79,28 @@ def _reset_for_tests() -> None:
 
 # ------------------------------------------------------------ render
 def _metric_name(ev: str) -> str:
+    """Sanitize a dotted obs name into a spec-valid Prometheus metric
+    name: ``perf.mfu`` → ``hpnn_perf_mfu``.  The ``hpnn_`` prefix
+    guarantees a legal leading character whatever the event name."""
     return "hpnn_" + _NAME_RE.sub("_", ev)
+
+
+def _escape_label_value(v) -> str:
+    """Escape one label value per the exposition spec: backslash,
+    double-quote and newline are the only characters that need it."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _render_labels(labels: dict) -> str:
+    """Render ``{k="v",...}`` with sanitized names and escaped
+    values; empty dict renders to nothing."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_NAME_RE.sub("_", str(k))}="{_escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
 
 
 def _fmt(v) -> str:
@@ -137,7 +158,8 @@ def render_prometheus(snap: dict | None) -> str:
         lines.append(f"# TYPE {m} summary")
         for q in QUANTILES:
             est = _quantile_estimate(agg, q)
-            lines.append(f'{m}{{quantile="{q}"}} {_fmt(est)}')
+            labels = _render_labels({"quantile": q})
+            lines.append(f"{m}{labels} {_fmt(est)}")
         lines.append(f"{m}_sum {_fmt(agg['total'])}")
         lines.append(f"{m}_count {agg['n']}")
     return "\n".join(lines) + "\n"

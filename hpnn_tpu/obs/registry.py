@@ -203,9 +203,14 @@ def _init():
                 path = ""
                 fp = None
         if fp is None:
-            # file-less activation: the flight ring and the export
-            # snapshot still want the records even without a sink
-            if not (_memory_requested or flight.enabled()):
+            # file-less activation: the flight ring, the export
+            # snapshot, and the performance-attribution knobs
+            # (HPNN_SPANS / HPNN_COST feed the in-memory aggregates
+            # that /metrics scrapes) still want the records even
+            # without a sink
+            if not (_memory_requested or flight.enabled()
+                    or os.environ.get("HPNN_SPANS")
+                    or os.environ.get("HPNN_COST")):
                 _state = False
                 return False
             path = None
@@ -504,7 +509,8 @@ def _reset_for_tests() -> None:
     # chain the sibling memos; sys.modules.get avoids import cycles
     # (export/ledger/probes all import registry)
     for name in ("hpnn_tpu.obs.export", "hpnn_tpu.obs.ledger",
-                 "hpnn_tpu.obs.probes"):
+                 "hpnn_tpu.obs.probes", "hpnn_tpu.obs.cost",
+                 "hpnn_tpu.obs.spans"):
         mod = sys.modules.get(name)
         if mod is not None:
             mod._reset_for_tests()

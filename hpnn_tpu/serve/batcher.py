@@ -55,9 +55,9 @@ class DeadlineExceeded(TimeoutError):
 
 class _Request:
     __slots__ = ("payload", "rows", "deadline", "submitted",
-                 "event", "result", "error")
+                 "event", "result", "error", "span", "qspan")
 
-    def __init__(self, payload, rows, deadline, submitted):
+    def __init__(self, payload, rows, deadline, submitted, span=None):
         self.payload = payload
         self.rows = rows              # device cost: how many batch rows
         self.deadline = deadline      # absolute, in clock() units
@@ -65,6 +65,8 @@ class _Request:
         self.event = threading.Event()
         self.result = None
         self.error: BaseException | None = None
+        self.span = span              # caller's root span (HPNN_SPANS)
+        self.qspan = None             # queue-wait span, closed on pop
 
     def finish(self, result=None, error: BaseException | None = None):
         self.result = result
@@ -119,14 +121,23 @@ class Batcher:
 
     # ------------------------------------------------------------ submit
     def submit(self, payload, *, rows: int = 1,
-               timeout_s: float = 5.0) -> _Request:
+               timeout_s: float = 5.0, span=None) -> _Request:
         """Enqueue one request; returns its ticket (wait via
         :meth:`result`).  Raises :class:`QueueFull` when the queue is
-        at ``max_depth``."""
+        at ``max_depth``.  ``span`` (HPNN_SPANS) is the caller's root
+        span: the queue-wait child opens here and closes when the
+        drain loop pops (or expires) the request, so queue time is
+        attributable separately from dispatch time."""
         if rows < 1:
             raise ValueError("rows must be >= 1")
         now = self._clock()
-        req = _Request(payload, int(rows), now + float(timeout_s), now)
+        req = _Request(payload, int(rows), now + float(timeout_s), now,
+                       span=span)
+        if obs.spans.enabled():
+            # before the append: the drain thread may pop the request
+            # the instant it lands in the queue
+            req.qspan = obs.spans.start("serve.queue", parent=span,
+                                        batcher=self.name)
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"batcher {self.name!r} is closed")
@@ -153,9 +164,11 @@ class Batcher:
             raise req.error
         return req.result
 
-    def infer(self, payload, *, rows: int = 1, timeout_s: float = 5.0):
+    def infer(self, payload, *, rows: int = 1, timeout_s: float = 5.0,
+              span=None):
         """submit + result in one call (the common embedding path)."""
-        req = self.submit(payload, rows=rows, timeout_s=timeout_s)
+        req = self.submit(payload, rows=rows, timeout_s=timeout_s,
+                          span=span)
         # small slack past the request deadline: the drain loop is the
         # authority on expiry; this wait is just a liveness backstop
         return self.result(req, timeout_s=float(timeout_s) + 1.0)
@@ -211,10 +224,13 @@ class Batcher:
             depth = len(self._queue)
         for req in expired:
             obs.count("serve.deadline_exceeded", batcher=self.name)
+            obs.spans.finish(req.qspan, failed="DeadlineExceeded")
             req.finish(error=DeadlineExceeded(
                 "request expired in queue before dispatch"))
         if expired:
             obs.gauge("serve.queue_depth", depth, batcher=self.name)
+        for req in batch:
+            obs.spans.finish(req.qspan)
         return batch or None
 
     def drain_once(self, *, block: bool = False) -> int:
@@ -231,6 +247,13 @@ class Batcher:
                     batcher=self.name)
         obs.observe("serve.batch_size", [sum(r.rows for r in batch)],
                     batcher=self.name, requests=len(batch))
+        # the dispatch span parents to the oldest request's root span —
+        # a coalesced batch has one device dispatch but many roots, and
+        # the oldest waiter is the one whose latency budget it spends
+        dspan = obs.spans.start("serve.dispatch", parent=batch[0].span,
+                                batcher=self.name,
+                                rows=sum(r.rows for r in batch),
+                                requests=len(batch))
         try:
             results = self._dispatch([r.payload for r in batch])
             if len(results) != len(batch):
@@ -238,11 +261,13 @@ class Batcher:
                     f"dispatch returned {len(results)} results for "
                     f"{len(batch)} requests")
         except BaseException as exc:  # fail the whole batch
+            obs.spans.finish(dspan, failed=type(exc).__name__)
             obs.count("serve.batch_failed", batcher=self.name,
                       requests=len(batch))
             for req in batch:
                 req.finish(error=exc)
             return len(batch)
+        obs.spans.finish(dspan)
         for req, res in zip(batch, results):
             req.finish(result=res)
         obs.gauge("serve.queue_depth", self.depth(), batcher=self.name)

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -112,6 +113,9 @@ class Engine:
         self._lock = threading.Lock()
         self._compiled: dict[tuple, object] = {}
         self._weights_cache: dict[tuple, tuple] = {}
+        # (name, version, bucket, dtype) -> hits / misses / compile_s:
+        # the cold-start cost surface exposed on /healthz
+        self._cache_stats: dict[tuple, dict] = {}
 
     @property
     def mode(self) -> str:
@@ -154,13 +158,15 @@ class Engine:
         key = (entry.name, entry.version, bucket, dtype.str)
         with self._lock:
             fn = self._compiled.get(key)
-        if fn is not None:
-            return fn
+            if fn is not None:
+                self._stat(key)["hits"] += 1
+                return fn
         if entry.model == "snn":
             from hpnn_tpu.models import snn as model
         else:
             from hpnn_tpu.models import ann as model
 
+        t_fill = time.perf_counter()
         if self.mode == "parity":
             # the HOST weights, verbatim: ``ann.run`` on numpy weights
             # computes its first-layer GEMV in numpy BLAS and the rest
@@ -189,6 +195,14 @@ class Engine:
                 with jax.default_matmul_precision("float32"):
                     fn = (jax.jit(batch_forward, donate_argnums=donate)
                           .lower(shape).compile())
+        fill_s = time.perf_counter() - t_fill
+        if self.mode == "compiled":
+            # the serve buckets are the one place an AOT executable is
+            # already in hand — cataloging it costs no extra compile
+            obs.cost.note_executable(
+                self._exe_name(key), fn, units=bucket,
+                compile_s=fill_s, kernel=entry.name,
+                version=entry.version, bucket=bucket, mode=self.mode)
         obs.count("serve.compile", kernel=entry.name,
                   version=entry.version, bucket=bucket, dtype=dtype.str,
                   mode=self.mode)
@@ -196,7 +210,35 @@ class Engine:
             # a racing fill of the same key is harmless (identical
             # executable); last writer wins
             self._compiled[key] = fn
+            stat = self._stat(key)
+            stat["misses"] += 1
+            stat["compile_s"] += fill_s
         return fn
+
+    @staticmethod
+    def _exe_name(key: tuple) -> str:
+        name, version, bucket, _dtype = key
+        return f"serve.{name}.v{version}.b{bucket}"
+
+    def _stat(self, key: tuple) -> dict:
+        # callers hold self._lock
+        stat = self._cache_stats.get(key)
+        if stat is None:
+            stat = self._cache_stats[key] = {
+                "hits": 0, "misses": 0, "compile_s": 0.0}
+        return stat
+
+    def cache_stats(self) -> dict[str, dict]:
+        """Per-(kernel, version, bucket) compile-cache census for
+        ``/healthz``: hits, misses, cumulative compile seconds.  After
+        warmup every entry should show ``misses == 1`` and a growing
+        hit count — a second miss is a cold-start regression."""
+        with self._lock:
+            return {
+                f"{k[0]}/v{k[1]}/b{k[2]}": {
+                    "hits": s["hits"], "misses": s["misses"],
+                    "compile_s": round(s["compile_s"], 6)}
+                for k, s in sorted(self._cache_stats.items())}
 
     def warmup(self, names=None, *, dtype=None) -> int:
         """Compile the full bucket menu for ``names`` (default: every
@@ -244,7 +286,17 @@ class Engine:
                 block[:n] = rows[start:start + n]
             else:
                 block = rows[start:start + n]
-            res = np.asarray(fn(block))
+            if obs.cost.enabled():
+                t0 = time.perf_counter()
+                res = np.asarray(fn(block))
+                # padding does the full bucket's work, so the cataloged
+                # (per-bucket) cost applies unscaled
+                obs.cost.record_dispatch(
+                    self._exe_name((entry.name, entry.version, bucket,
+                                    dtype.str)),
+                    time.perf_counter() - t0)
+            else:
+                res = np.asarray(fn(block))
             out[start:start + n] = res[:n]
             start += n
         return out

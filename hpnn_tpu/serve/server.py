@@ -116,6 +116,7 @@ class Session:
             "kernels": self.registry.names(),
             "buckets": list(self.engine.buckets),
             "compiled": self.engine.compiled_count(),
+            "compile_cache": self.engine.cache_stats(),
             "batchers": {
                 name: {"depth": b.depth(),
                        "oldest_wait_s": b.oldest_age()}
@@ -156,10 +157,19 @@ class Session:
         single = arr.ndim == 1
         rows = np.atleast_2d(arr)
         batcher = self.batcher_for(name)
-        with obs.timer("serve.request", kernel=name,
-                       rows=rows.shape[0]):
-            out = batcher.infer(rows, rows=rows.shape[0],
-                                timeout_s=timeout_s)
+        # root of the request lifecycle: serve.queue / serve.dispatch
+        # children hang off it across the batcher threads (HPNN_SPANS)
+        span = obs.spans.start("serve.request", kernel=name,
+                               rows=rows.shape[0])
+        try:
+            with obs.timer("serve.request", kernel=name,
+                           rows=rows.shape[0]):
+                out = batcher.infer(rows, rows=rows.shape[0],
+                                    timeout_s=timeout_s, span=span)
+        except BaseException as exc:
+            obs.spans.finish(span, failed=type(exc).__name__)
+            raise
+        obs.spans.finish(span)
         return out[0] if single else out
 
     # ------------------------------------------------------------ close

@@ -658,6 +658,7 @@ def train_kernel_batched(
               epochs=epochs, body="pallas" if use_pallas else "xla",
               bank=bank_refresh, data_shards=n_data,
               resumed=state is not None)
+    round_span = obs.spans.start("train.round", mode="batch")
 
     # most recent bank permutation: a sub-R dispatch block (shrunken
     # survival cap) can start mid-refresh-group and must reuse the
@@ -764,6 +765,18 @@ def train_kernel_batched(
                              ).astype(np.int32).reshape(e_block, n_steps, B),
                     rep,
                 ),)
+            if obs.cost.enabled() and block_i == 0:
+                # catalog the multi-epoch executable once (a separate
+                # introspection compile — the dispatch path and its
+                # donation discipline are untouched); per-block perf
+                # gauges scale the cost by each block's epoch count
+                obs.cost.analyze_fn(
+                    "batch.multi_epoch", multi_fn, w_sh, dw_sh,
+                    X_dev, T_dev, *data_args, units=e_block,
+                    body="pallas" if use_pallas else "xla")
+            bspan = obs.spans.start("batch.block", parent=round_span,
+                                    i=block_i, epoch=epoch,
+                                    epochs=e_block)
             t0 = _time.monotonic()
             try:
                 with obs.step_annotation("hpnn.batch_block", block_i), \
@@ -775,6 +788,7 @@ def train_kernel_batched(
                     losses = dp.host_fetch(losses, mesh)
                     counts = dp.host_fetch(counts, mesh)
             except Exception as exc:
+                obs.spans.finish(bspan, failed=type(exc).__name__)
                 if (
                     block_i == 0
                     and use_pallas
@@ -811,6 +825,11 @@ def train_kernel_batched(
                     continue
                 raise
             dt = _time.monotonic() - t0
+            obs.spans.finish(bspan)
+            if obs.cost.enabled():
+                # dt was already measured for the dispatch-budget cap
+                obs.cost.record_dispatch("batch.multi_epoch", dt,
+                                         units=e_block)
             if block_i == 1 and timed_cap is None:
                 # first compile-free block: freeze the time-based cap
                 timed_cap = max(1, int(budget_s * e_block / max(dt, 1e-3)))
@@ -837,15 +856,29 @@ def train_kernel_batched(
                                                for w in w_sh])
             _save_state(epoch, cap=e_cap)
     else:
+        import time as _time
+
         for epoch in range(done_epochs + 1, epochs + 1):
             order = draw_perm()
             Xe = Xd[order].reshape(n_steps, B, -1)
             Te = Td[order].reshape(n_steps, B, -1)
             Xs, Ts = dp.shard_batch_steps(Xe, Te, mesh)
+            if obs.cost.enabled():
+                # memo hit after the first epoch (catalog keyed by name)
+                obs.cost.analyze_fn("batch.epoch_fn", epoch_fn,
+                                    w_sh, dw_sh, Xs, Ts, units=1,
+                                    body="xla")
+            bspan = obs.spans.start("batch.block", parent=round_span,
+                                    epoch=epoch, epochs=1)
+            t0 = _time.monotonic()
             with obs.timer("batch.block_dispatch", epoch=epoch,
                            epochs=1, body="xla"):
                 w_sh, dw_sh, losses = epoch_fn(w_sh, dw_sh, Xs, Ts)
                 losses = dp.host_fetch(losses, mesh)
+            obs.spans.finish(bspan)
+            if obs.cost.enabled():
+                obs.cost.record_dispatch("batch.epoch_fn",
+                                         _time.monotonic() - t0)
             loss = float(jnp.mean(losses))
             out = np.asarray(eval_fn(w_sh, X_eval))
             okc = accuracy_counts(out, T, model)
@@ -867,6 +900,7 @@ def train_kernel_batched(
         os.remove(state_path)
     obs.event("round.end", mode="batch", epochs=epochs, loss=loss,
               body="pallas" if use_pallas else "xla")
+    obs.spans.finish(round_span, epochs=epochs)
     obs.summary()
     return True
 
@@ -924,7 +958,12 @@ def run_kernel_batched(conf: NNConf) -> None:
     from hpnn_tpu.utils import debug
 
     debug.device_alloc_report(weights)
-    with obs.annotate("hpnn.eval_forward"), \
+    if obs.cost.enabled():
+        obs.cost.analyze_fn("batch.eval_forward", eval_fn, weights,
+                            jnp.asarray(X.astype(dtype)),
+                            units=len(names))
+    with obs.spans.span("eval.batch_forward", files=len(names)), \
+            obs.annotate("hpnn.eval_forward"), \
             obs.timer("eval.batch_forward", size=len(names)):
         out = np.asarray(eval_fn(weights, jnp.asarray(X.astype(dtype))))
     obs.event("eval.round", files=len(all_files), batched=len(names),

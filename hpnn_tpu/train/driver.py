@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -308,6 +309,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             chunk=chunk, body="pallas" if use_pallas_epoch else "lax",
             resumed=state is not None,
         )
+        round_span = obs.spans.start("train.round", mode="fused")
         obs.device.sample("round_start")
         fname_it = iter(zip(files, readable))
 
@@ -330,6 +332,18 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             Xc = X[done : done + chunk]
             Tc = T[done : done + chunk]
             body = "pallas" if use_pallas_epoch else "lax"
+            if obs.cost.enabled() and chunk_i == 0:
+                # catalog the fused-epoch executable once per round:
+                # ONE extra introspection compile, separate from the
+                # dispatch path; a closure that cannot retrace (the TP
+                # epoch's host-side padding) records an error entry
+                obs.cost.analyze_fn(
+                    "driver.train_epoch", train_epoch, weights, dw0,
+                    Xc, Tc, units=int(Xc.shape[0]), body=body)
+            cspan = obs.spans.start("train.chunk", parent=round_span,
+                                    i=chunk_i, size=int(Xc.shape[0]),
+                                    body=body)
+            t_disp = time.perf_counter() if obs.cost.enabled() else 0.0
             try:
                 # the timer brackets dispatch AND the stats fetch (the
                 # host transfer is the fence — same discipline as
@@ -340,6 +354,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                     weights, stats = train_epoch(weights, dw0, Xc, Tc)
                     stats = tuple(np.asarray(s) for s in stats)
             except Exception as exc:
+                obs.spans.finish(cspan, failed=type(exc).__name__)
                 if (chunk_i == 0 and use_pallas_epoch
                         and "UNAVAILABLE" not in str(exc)):
                     # Mosaic refused the fused-epoch kernel (the
@@ -389,12 +404,19 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
                     )
                 obs.event("round.abort", mode="fused", done=done,
                           exc=type(exc).__name__)
+                obs.spans.finish(round_span, failed=type(exc).__name__)
                 obs.flush()
                 obs.flight.dump("round.abort")
                 obs.export.set_health(last_round={
                     "mode": "fused", "ok": False, "done": done,
                     "exc": type(exc).__name__})
                 raise
+            if obs.cost.enabled():
+                # dispatch + stats fetch, same bracket as the timer
+                obs.cost.record_dispatch(
+                    "driver.train_epoch", time.perf_counter() - t_disp,
+                    units=int(Xc.shape[0]))
+            obs.spans.finish(cspan)
             done += int(Xc.shape[0])
             chunk_i += 1
             if obs.enabled():
@@ -430,6 +452,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
         obs.event("round.end", mode="fused", samples=done,
                   chunks=chunk_i, body="pallas" if use_pallas_epoch
                   else "lax")
+        obs.spans.finish(round_span, samples=done, chunks=chunk_i)
         obs.device.sample("round_end")
         obs.export.set_health(last_round={
             "mode": "fused", "ok": True, "samples": done,
@@ -445,6 +468,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             )
         )
         obs.event("round.start", mode="streaming", samples=len(files))
+        round_span = obs.spans.start("train.round", mode="streaming")
         # per-round convergence stats; the token printer already syncs
         # every per-sample scalar, so collecting them is free — but
         # only collect when the sink is live (zero-overhead rule)
@@ -457,7 +481,18 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             tr_in, tr_out = sample
             if momentum:
                 dw = dw0  # raz_momentum: fresh zeros each sample
-            res = train_one(weights, dw, tr_in, tr_out)
+            if obs.cost.enabled():
+                # first call catalogs the per-sample step (memo hit
+                # afterwards); the clock pair feeds the perf gauges
+                obs.cost.analyze_fn("driver.train_sample", train_one,
+                                    weights, dw, tr_in, tr_out, units=1)
+                t_disp = time.perf_counter()
+                res = train_one(weights, dw, tr_in, tr_out)
+                obs.cost.record_dispatch(
+                    "driver.train_sample",
+                    time.perf_counter() - t_disp)
+            else:
+                res = train_one(weights, dw, tr_in, tr_out)
             weights, dw = res.weights, res.dw
             _print_train_tokens(res, model, momentum)
             if n_iters is not None:
@@ -474,6 +509,7 @@ def train_kernel(conf: NNConf, mesh=None) -> bool:
             obs.probes.check_weights(weights, step=len(files),
                                      where="round")
         obs.event("round.end", mode="streaming", samples=len(files))
+        obs.spans.finish(round_span, samples=len(files))
         obs.device.sample("round_end")
         obs.export.set_health(last_round={
             "mode": "streaming", "ok": True, "samples": len(files)})
@@ -820,7 +856,9 @@ def run_kernel(conf: NNConf, mesh=None) -> None:
             return
         if batched_fwd is None:
             batched_fwd = _make_batched_fwd()
-        with obs.annotate("hpnn.eval_forward"), \
+        with obs.spans.span("eval.batch_forward",
+                            files=len(grp_files)), \
+                obs.annotate("hpnn.eval_forward"), \
                 obs.timer("eval.batch_forward", size=len(grp_files)):
             oc = batched_fwd(np.stack(grp_x).astype(dtype))
         for j, f in enumerate(grp_files):

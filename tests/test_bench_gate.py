@@ -1,0 +1,155 @@
+"""The bench regression gate (tools/bench_gate.py) and the
+bench-history trajectory it reads.
+
+The gate is CI surface: exit 0 on a healthy candidate, non-zero on
+regression, 2 on usage/IO — asserted through real subprocess runs so
+the exit codes are the ones a pipeline would see."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(ROOT, "tools", "bench_gate.py")
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location("bench_gate", GATE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _entry(slope_xla=100.0, sps=50.0, value=200.0, **extra):
+    e = {
+        "metric": "samples_per_s", "value": value, "unit": "1/s",
+        "batch_sps_median": sps,
+        "slope_us_per_step": {"xla": slope_xla, "pallas": slope_xla / 2},
+        "serve_p50_ms": 1.0, "serve_p99_ms": 3.0, "serve_rps": 900.0,
+        "git_sha": "abc1234", "when": "2026-08-05T12:00:00+0000",
+    }
+    e.update(extra)
+    return e
+
+
+def _write_history(path, entries):
+    with open(path, "w") as fp:
+        for e in entries:
+            fp.write(json.dumps(e) + "\n")
+
+
+def _run(args, cwd):
+    return subprocess.run(
+        [sys.executable, GATE] + args, cwd=cwd,
+        capture_output=True, text=True, timeout=120)
+
+
+# ---------------------------------------------------------- unit level
+def test_flatten_and_baseline_median():
+    g = _load_gate()
+    flat = g.flatten(_entry(slope_xla=100.0))
+    assert flat["slope_us_per_step.xla"] == 100.0
+    assert flat["slope_us_per_step.pallas"] == 50.0
+    assert flat["batch_sps_median"] == 50.0
+    assert "git_sha" not in flat and "metric" not in flat
+    hist = [_entry(slope_xla=v) for v in (90.0, 100.0, 110.0, 400.0)]
+    base = g.baseline(hist, window=3)       # newest 3: 100, 110, 400
+    assert base["slope_us_per_step.xla"] == 110.0
+
+
+def test_gate_directions():
+    g = _load_gate()
+    base = {"batch_sps_median": 100.0, "slope_us_per_step.xla": 100.0}
+    # within tolerance both ways
+    assert g.gate({"batch_sps_median": 90.0,
+                   "slope_us_per_step.xla": 110.0}, base) == []
+    # throughput regresses DOWNWARD ...
+    bad = g.gate({"batch_sps_median": 40.0}, base)
+    assert len(bad) == 1 and bad[0]["metric"] == "batch_sps_median"
+    # ... but a big throughput GAIN is not a regression
+    assert g.gate({"batch_sps_median": 500.0}, base) == []
+    # slopes regress UPWARD; a faster slope is fine
+    assert g.gate({"slope_us_per_step.xla": 10.0}, base) == []
+    bad = g.gate({"slope_us_per_step.xla": 200.0}, base)
+    assert len(bad) == 1 and bad[0]["ratio"] == 2.0
+    # metrics absent from the baseline are skipped, not failed
+    assert g.gate({"serve_rps": 1.0}, base) == []
+
+
+# ------------------------------------------------------ subprocess CLI
+def test_gate_passes_on_steady_trajectory(tmp_path):
+    hist = tmp_path / "bench_history.jsonl"
+    _write_history(hist, [_entry() for _ in range(4)])
+    p = _run(["--history", str(hist)], cwd=str(tmp_path))
+    assert p.returncode == 0, p.stderr
+    assert "PASS" in p.stdout
+
+
+def test_gate_fails_on_2x_slope_regression(tmp_path):
+    """The acceptance case: a synthetic 2x slope_us_per_step
+    regression in the candidate must exit non-zero and name the
+    metric."""
+    hist = tmp_path / "bench_history.jsonl"
+    _write_history(hist, [_entry(slope_xla=100.0) for _ in range(4)])
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_entry(slope_xla=200.0)))
+    p = _run(["--history", str(hist), "--candidate", str(cand)],
+             cwd=str(tmp_path))
+    assert p.returncode == 1, (p.stdout, p.stderr)
+    assert "FAIL" in p.stdout
+    assert "slope_us_per_step.xla" in p.stdout
+    # same verdict machine-readably
+    p = _run(["--history", str(hist), "--candidate", str(cand),
+              "--json"], cwd=str(tmp_path))
+    assert p.returncode == 1
+    verdict = json.loads(p.stdout)
+    assert verdict["pass"] is False
+    assert any(r["metric"] == "slope_us_per_step.xla"
+               for r in verdict["regressions"])
+
+
+def test_gate_default_candidate_is_last_history_line(tmp_path):
+    hist = tmp_path / "bench_history.jsonl"
+    _write_history(hist, [_entry(sps=50.0) for _ in range(3)]
+                   + [_entry(sps=5.0)])        # last run collapsed
+    p = _run(["--history", str(hist)], cwd=str(tmp_path))
+    assert p.returncode == 1
+    assert "batch_sps_median" in p.stdout
+
+
+def test_gate_tolerance_override_and_stdin(tmp_path):
+    hist = tmp_path / "bench_history.jsonl"
+    _write_history(hist, [_entry(sps=100.0) for _ in range(3)])
+    # 20% drop: fails a 10% tolerance, passes a 50% one — via stdin
+    cand = json.dumps(_entry(sps=80.0))
+    for tol, rc in (("0.1", 1), ("0.5", 0)):
+        p = subprocess.run(
+            [sys.executable, GATE, "--history", str(hist),
+             "--candidate", "-", "--tolerance", tol],
+            input=cand, cwd=str(tmp_path),
+            capture_output=True, text=True, timeout=120)
+        assert p.returncode == rc, (tol, p.stdout, p.stderr)
+
+
+def test_gate_usage_and_io_errors(tmp_path):
+    # missing history file
+    p = _run(["--history", str(tmp_path / "nope.jsonl")],
+             cwd=str(tmp_path))
+    assert p.returncode == 2
+    # empty history, no candidate
+    hist = tmp_path / "bench_history.jsonl"
+    hist.write_text("")
+    p = _run(["--history", str(hist)], cwd=str(tmp_path))
+    assert p.returncode == 2
+    # single entry + no prior baseline = nothing to gate (pass)
+    _write_history(hist, [_entry()])
+    p = _run(["--history", str(hist)], cwd=str(tmp_path))
+    assert p.returncode == 0
+    # torn tail line is skipped like obs_report does
+    _write_history(hist, [_entry() for _ in range(3)])
+    with open(hist, "a") as fp:
+        fp.write('{"torn": ')
+    p = _run(["--history", str(hist)], cwd=str(tmp_path))
+    assert p.returncode == 0, p.stderr

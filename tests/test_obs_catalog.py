@@ -130,6 +130,111 @@ def test_main_ledger_flag_exit_codes(tmp_path, capsys):
     capsys.readouterr()
 
 
+def _span(sid, parent, name, t0, dt, **over):
+    rec = {"ts": 0.0, "ev": "span.end", "kind": "event", "span": sid,
+           "parent": parent, "name": name, "t0": t0, "dt": dt}
+    rec.update(over)
+    return rec
+
+
+def _cost(exe, **over):
+    rec = {"ts": 0.0, "ev": "compile.cost", "kind": "event",
+           "exe": exe, "units": 8, "flops": 1e6,
+           "bytes_accessed": 2e5}
+    rec.update(over)
+    return rec
+
+
+def _perf(name, value, **over):
+    rec = {"ts": 0.0, "ev": name, "kind": "gauge", "value": value,
+           "exe": "unit.mm"}
+    rec.update(over)
+    return rec
+
+
+def _write_sink(path, recs):
+    import json
+
+    with open(path, "w") as fp:
+        for rec in recs:
+            fp.write(json.dumps(rec) + "\n")
+
+
+def test_perf_lint_accepts_a_well_formed_sink(tmp_path):
+    mod = _load()
+    path = tmp_path / "m.jsonl"
+    _write_sink(path, [
+        _span(1, None, "serve.request", 10.0, 1.0),
+        _span(2, 1, "serve.queue", 10.1, 0.2),
+        _span(3, 1, "serve.dispatch", 10.4, 0.5),
+        _cost("serve.k.v0.b8", compile_s=0.01),
+        _cost("unit.err", flops=None, bytes_accessed=None,
+              error="TracerConversionError"),
+        _perf("perf.flops_per_s", 1e8),
+        _perf("perf.mfu", 0.001),
+        {"ts": 0.0, "ev": "round.start", "kind": "event"},  # bystander
+    ])
+    assert mod.lint_perf(str(path)) == []
+
+
+def test_perf_lint_catches_every_schema_break(tmp_path):
+    """Each clause bites: missing keys, duplicate span id, a child
+    escaping its parent's interval, duplicate cost entry, bad units,
+    a non-gauge perf record, a negative rate, a missing exe, and an
+    empty sink."""
+    mod = _load()
+    path = tmp_path / "m.jsonl"
+
+    bad = _span(1, None, "a.b", 0.0, 1.0)
+    del bad["t0"]
+    _write_sink(path, [bad])
+    assert any("missing keys" in f for f in mod.lint_perf(str(path)))
+
+    _write_sink(path, [_span(1, None, "a.b", 0.0, 1.0),
+                       _span(1, None, "a.c", 0.5, 0.1)])
+    assert any("twice" in f for f in mod.lint_perf(str(path)))
+
+    # child [0.5, 2.5] escapes parent [0.0, 1.0]
+    _write_sink(path, [_span(1, None, "a.b", 0.0, 1.0),
+                       _span(2, 1, "a.c", 0.5, 2.0)])
+    assert any("escapes parent" in f for f in mod.lint_perf(str(path)))
+
+    _write_sink(path, [_cost("x.y"), _cost("x.y")])
+    assert any("duplicate" in f for f in mod.lint_perf(str(path)))
+
+    _write_sink(path, [_cost("x.y", units=0)])
+    assert any("units" in f for f in mod.lint_perf(str(path)))
+
+    _write_sink(path, [_perf("perf.mfu", 0.5, kind="event")])
+    assert any("gauge" in f for f in mod.lint_perf(str(path)))
+
+    _write_sink(path, [_perf("perf.flops_per_s", -1.0)])
+    assert any("non-negative" in f for f in mod.lint_perf(str(path)))
+
+    rec = _perf("perf.mfu", 0.5)
+    del rec["exe"]
+    _write_sink(path, [rec])
+    assert any("unattributable" in f for f in mod.lint_perf(str(path)))
+
+    _write_sink(path, [{"ts": 0.0, "ev": "round.start",
+                        "kind": "event"}])
+    assert any("no span.end" in f for f in mod.lint_perf(str(path)))
+
+    assert mod.lint_perf(str(tmp_path / "missing.jsonl"))
+
+
+def test_main_perf_flag_exit_codes(tmp_path, capsys):
+    mod = _load()
+    path = tmp_path / "m.jsonl"
+    _write_sink(path, [_span(1, None, "a.b", 0.0, 1.0)])
+    assert mod.main(["--perf", str(path)]) == 0
+    _write_sink(path, [_span(1, None, "a.b", 0.0, 1.0),
+                       _span(2, 1, "a.c", 0.5, 2.0)])
+    assert mod.main(["--perf", str(path)]) == 1
+    assert mod.main(["--perf"]) == 2
+    capsys.readouterr()
+
+
 def test_call_site_regex_matches_every_emitter_style(tmp_path):
     """obs.timer / bare event() / raw {"ev": ...} records all count."""
     mod = _load()

@@ -390,6 +390,58 @@ def test_sigterm_flushes_sink_and_dumps_flight(tmp_path):
     assert any(r["ev"] == "unit.work" for r in drecs[1:])
 
 
+def test_flight_ring_concurrent_serve_and_train_writers(tmp_path,
+                                                        monkeypatch):
+    """The ring under real concurrent producers: a serve session
+    hammered from client threads (its drain thread is a third writer)
+    while a train round emits from the main thread.  A dump taken
+    after the dust settles must hold exactly-capacity intact records —
+    no interleaved/torn lines — and never drop the newest event."""
+    from hpnn_tpu.train import driver
+
+    from tests.test_obs import _conf
+
+    dump = tmp_path / "flight.jsonl"
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    monkeypatch.setenv("HPNN_FLIGHT", str(dump))
+    monkeypatch.setenv("HPNN_FLIGHT_N", "64")
+    obs._reset_for_tests()
+
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    errors = []
+
+    def hammer():
+        try:
+            for _ in range(40):    # x3 threads: >> ring capacity
+                sess.infer("k", np.zeros(8))
+        except Exception as exc:  # surface thread crashes in the test
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        assert driver.train_kernel(_conf(tmp_path))
+    finally:
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors
+    sess.close()
+
+    obs.event("unit.newest")           # the event a dump may not drop
+    assert obs.flight.dump("concurrency") == str(dump)
+    recs = _read(dump)                 # every line parses = no tearing
+    header = recs[0]
+    assert header["ev"] == "flight.dump"
+    assert header["capacity"] == 64
+    assert header["events"] == 64      # ring full after all that
+    assert len(recs) == 65
+    assert all(isinstance(r, dict) and "ev" in r for r in recs[1:])
+    assert recs[-1]["ev"] == "unit.newest"
+
+
 # -------------------------------------------------------------- merge
 def test_merge_events_skew_tolerance(tmp_path):
     import importlib.util

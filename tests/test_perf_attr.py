@@ -1,0 +1,394 @@
+"""Performance attribution: spans, compiled-cost introspection, MFU
+gauges, the serve compile-cache census, the span report, and the
+Prometheus label escaping.
+
+Spans (obs/spans.py) give the stream causality — parent/child ids make
+queue wait separable from device time inside one request; cost
+introspection (obs/cost.py) turns dispatch wall time into FLOP/s and
+MFU via jax's AOT ``cost_analysis``.  Both ride the usual obs
+contract: unset ⇒ no-ops, never stdout."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import export
+from hpnn_tpu.serve.batcher import Batcher
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _kernel():
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return k
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _spans(path):
+    return [r for r in _read(path) if r["ev"] == "span.end"]
+
+
+# -------------------------------------------------------------- spans
+def test_spans_disabled_everything_noops(tmp_path, monkeypatch):
+    monkeypatch.delenv("HPNN_SPANS", raising=False)
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    assert not obs.spans.enabled()
+    sp = obs.spans.start("unit.x")
+    assert sp is obs.spans._NULL_SPAN and sp.id is None
+    obs.spans.finish(sp)                    # no raise
+    obs.spans.finish(None)
+    with obs.spans.span("unit.y") as s:
+        assert s.id is None                 # shared null span
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_span_ambient_nesting_and_record_shape(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    with obs.spans.span("unit.outer", tag="o"):
+        with obs.spans.span("unit.inner"):
+            pass
+    recs = _spans(sink)
+    # the inner span finishes (and emits) first
+    assert [r["name"] for r in recs] == ["unit.inner", "unit.outer"]
+    inner, outer = recs
+    assert outer["parent"] is None
+    assert inner["parent"] == outer["span"]
+    assert outer["tag"] == "o"
+    for r in recs:
+        assert isinstance(r["span"], int) and r["span"] >= 1
+        assert r["dt"] >= 0.0 and r["t0"] >= 0.0
+    # honest nesting: the child's interval sits inside the parent's
+    assert inner["t0"] >= outer["t0"] - 2e-6
+    assert inner["t0"] + inner["dt"] <= outer["t0"] + outer["dt"] + 2e-6
+    # ... and each finished span fed its span.<name> aggregate
+    aggs = obs.snapshot_state()["aggregates"]
+    assert aggs["span.unit.outer"]["n"] == 1
+    assert aggs["span.unit.inner"]["n"] == 1
+
+
+def test_span_failed_field_on_exception(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    with pytest.raises(RuntimeError):
+        with obs.spans.span("unit.bad"):
+            raise RuntimeError("boom")
+    (rec,) = _spans(sink)
+    assert rec["failed"] == "RuntimeError"
+
+
+def test_span_cross_thread_handoff(tmp_path, monkeypatch):
+    """start/finish never touch the ambient stack — a child opened on
+    another thread parents to the explicitly-passed root."""
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    root = obs.spans.start("unit.root")
+
+    def worker():
+        child = obs.spans.start("unit.child", parent=root)
+        obs.spans.finish(child)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=10)
+    obs.spans.finish(root, extra=1)
+    by = {r["name"]: r for r in _spans(sink)}
+    assert by["unit.child"]["parent"] == by["unit.root"]["span"]
+    assert by["unit.root"]["extra"] == 1
+    # finish is idempotent: closing again emits nothing new
+    obs.spans.finish(root)
+    assert len(_spans(sink)) == 2
+
+
+def test_spans_fileless_activation(monkeypatch):
+    """HPNN_SPANS alone (no metrics sink) arms in-memory aggregation,
+    like HPNN_FLIGHT does — spans must not need HPNN_METRICS."""
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    obs._reset_for_tests()
+    assert obs.enabled() and obs.sink_path() is None
+    with obs.spans.span("unit.fileless"):
+        pass
+    assert obs.snapshot_state()["aggregates"]["span.unit.fileless"][
+        "n"] == 1
+
+
+# --------------------------------------------------------------- cost
+def _mm(a, b):
+    return a @ b
+
+
+def test_cost_catalog_and_perf_gauges(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_COST", "1")
+    monkeypatch.setenv("HPNN_PEAK_FLOPS", "1e9")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    a = np.zeros((8, 16), np.float32)
+    b = np.zeros((16, 8), np.float32)
+    obs.cost.analyze_fn("unit.mm", _mm, a, b, units=8, body="xla")
+    cat = obs.cost.catalog()
+    assert cat["unit.mm"]["flops"] and cat["unit.mm"]["flops"] > 0
+    assert cat["unit.mm"]["units"] == 8
+    # a second analyze is a memo hit — ONE compile.cost record total
+    obs.cost.analyze_fn("unit.mm", _mm, a, b, units=8)
+    costs = [r for r in _read(sink) if r["ev"] == "compile.cost"]
+    assert len(costs) == 1
+    rec = costs[0]
+    assert rec["exe"] == "unit.mm" and rec["kind"] == "event"
+    assert rec["flops"] == cat["unit.mm"]["flops"]
+    assert rec["units"] == 8 and rec["body"] == "xla"
+    assert rec["compile_s"] >= 0.0
+
+    assert obs.cost.peak_flops() == 1e9
+    obs.cost.record_dispatch("unit.mm", 0.01)
+    gauges = obs.snapshot_state()["gauges"]
+    fps = cat["unit.mm"]["flops"] / 0.01
+    assert gauges["perf.flops_per_s"] == pytest.approx(fps)
+    assert gauges["perf.mfu"] == pytest.approx(fps / 1e9)
+    if cat["unit.mm"]["bytes"]:
+        assert gauges["perf.bytes_per_s"] == pytest.approx(
+            cat["unit.mm"]["bytes"] / 0.01)
+    # the gauge records carry the attributing exe field
+    perf = [r for r in _read(sink) if r["ev"].startswith("perf.")]
+    assert perf and all(r["exe"] == "unit.mm" for r in perf)
+    # units scale the cataloged cost: double the work, double the rate
+    obs.cost.record_dispatch("unit.mm", 0.01, units=16)
+    assert obs.snapshot_state()["gauges"][
+        "perf.flops_per_s"] == pytest.approx(2 * fps)
+
+
+def test_cost_error_is_cached_never_raised(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_COST", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+
+    def hostile(x):
+        return float(x)        # TracerConversionError under jit
+
+    obs.cost.analyze_fn("unit.bad", hostile, np.zeros(4), units=2)
+    assert obs.cost.catalog()["unit.bad"]["flops"] is None
+    obs.cost.analyze_fn("unit.bad", hostile, np.zeros(4), units=2)
+    costs = [r for r in _read(sink) if r["ev"] == "compile.cost"]
+    assert len(costs) == 1 and "error" in costs[0]
+    # a costless entry produces no perf gauges and never raises
+    obs.cost.record_dispatch("unit.bad", 0.01)
+    obs.cost.record_dispatch("unit.unknown", 0.01)
+    assert "perf.flops_per_s" not in (obs.snapshot_state() or
+                                      {"gauges": {}})["gauges"]
+
+
+def test_cost_disabled_noop(monkeypatch):
+    monkeypatch.delenv("HPNN_COST", raising=False)
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    obs.cost.analyze_fn("unit.off", _mm, np.zeros((2, 2)),
+                        np.zeros((2, 2)))
+    obs.cost.record_dispatch("unit.off", 0.01)
+    assert obs.cost.catalog() == {}
+
+
+# -------------------------------------------------------------- serve
+def test_serve_request_span_lifecycle(tmp_path, monkeypatch):
+    """One infer = a serve.request root with serve.queue and
+    serve.dispatch children; queue wait and device time are separable
+    and their sum stays inside the request."""
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    sess.infer("k", np.zeros(8))
+    sess.close()
+    by = {}
+    for r in _spans(sink):
+        by.setdefault(r["name"], r)
+    req = by["serve.request"]
+    assert by["serve.queue"]["parent"] == req["span"]
+    assert by["serve.dispatch"]["parent"] == req["span"]
+    assert by["serve.dispatch"]["rows"] == 1
+    assert (by["serve.queue"]["dt"] + by["serve.dispatch"]["dt"]
+            <= req["dt"] + 5e-5)
+
+
+def test_serve_queue_deadline_span(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    now = [100.0]
+    b = Batcher(lambda payloads: [None] * len(payloads),
+                clock=lambda: now[0], start=False)
+    b.submit("x", timeout_s=1.0)
+    now[0] = 102.0                      # expire in queue
+    assert b.drain_once() == 0
+    b.close()
+    (rec,) = [r for r in _spans(sink) if r["name"] == "serve.queue"]
+    assert rec["failed"] == "DeadlineExceeded"
+
+
+def test_engine_cache_stats_and_healthz(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())    # warmup fills every bucket
+    stats = sess.engine.cache_stats()
+    assert len(stats) == len(sess.engine.buckets)
+    for key, s in stats.items():
+        assert key.startswith("k/v0/b")
+        assert s["misses"] == 1 and s["compile_s"] >= 0.0
+    hits_before = sum(s["hits"] for s in stats.values())
+    sess.infer("k", np.zeros(8))            # cache hit, no new miss
+    stats = sess.engine.cache_stats()
+    assert sum(s["hits"] for s in stats.values()) == hits_before + 1
+    assert sum(s["misses"] for s in stats.values()) == len(stats)
+    assert sess.health()["compile_cache"] == stats
+    sess.close()
+
+
+def test_serve_cost_gauges_reach_metrics(tmp_path, monkeypatch):
+    """Compiled-mode serve: each bucket executable is cost-cataloged
+    at warmup (compile.cost) and every dispatch updates the perf
+    gauges, visible in a /metrics render."""
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_COST", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0,
+                         mode="compiled")
+    sess.register_kernel("k", _kernel())
+    sess.infer("k", np.zeros(8))
+    sess.close()
+    exes = {r["exe"] for r in _read(sink) if r["ev"] == "compile.cost"}
+    assert exes == {f"serve.k.v0.b{b}" for b in sess.engine.buckets}
+    body = export.metrics_body().decode()
+    assert "hpnn_perf_flops_per_s" in body
+    assert "hpnn_perf_mfu" in body
+
+
+# ------------------------------------------------------------- report
+def test_obs_report_spans_on_recorded_run(tmp_path, monkeypatch,
+                                          capsys):
+    """The acceptance read-back: record a serve+train run with spans
+    on, then --spans renders a tree where every parent's children sum
+    to ≤ its own duration and queue wait is its own line."""
+    from hpnn_tpu.train import driver
+
+    from tests.test_obs import _conf
+
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    sess.infer("k", np.zeros(8))
+    sess.close()
+    assert driver.train_kernel(_conf(tmp_path))
+    obs.flush()
+
+    rpt = _load_tool("obs_report")
+    events = rpt.load_events(str(sink))
+    spans = rpt.collect_spans(events)
+    names = {s["name"] for s in spans}
+    assert {"serve.request", "serve.queue", "serve.dispatch",
+            "train.round", "train.chunk"} <= names
+
+    def walk(node):
+        assert node["child_s"] <= node["dt"] + 1e-4, node
+        for c in node["children"]:
+            walk(c)
+
+    roots = rpt.span_tree(spans)
+    assert roots
+    for r in roots:
+        walk(r)
+    text = rpt.render_spans(events, top=5)
+    assert "serve.queue" in text and "train.chunk" in text
+    assert "-- slowest" in text
+    assert rpt.main([str(sink), "--spans"]) == 0
+    out = capsys.readouterr().out
+    assert "== span report ==" in out
+
+    # the recorded sink also satisfies the span/cost schema lint
+    lint = _load_tool("check_obs_catalog")
+    assert lint.lint_perf(str(sink)) == []
+
+
+# ------------------------------------------------------------- export
+def _parse_label_value(s):
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\":
+            out.append({"n": "\n", '"': '"', "\\": "\\"}[s[i + 1]])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def test_export_label_escaping_round_trip(tmp_path, monkeypatch):
+    assert export._metric_name("perf.mfu") == "hpnn_perf_mfu"
+    val = 'a"b\\c\nd'
+    esc = export._escape_label_value(val)
+    assert "\n" not in esc                  # exposition is line-based
+    assert _parse_label_value(esc) == val
+    rendered = export._render_labels({"exe": val, "quantile": 0.5})
+    assert rendered.startswith("{") and rendered.endswith("}")
+    assert export._render_labels({}) == ""
+
+    # full exposition round trip: render a live snapshot and parse
+    # every sample line back per the 0.0.4 grammar
+    import re
+
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    obs._reset_for_tests()
+    obs.gauge("perf.mfu", 0.25)
+    obs.observe("unit.lat", [1.0, 2.0])
+    text = export.render_prometheus(obs.snapshot_state())
+    assert "hpnn_perf_mfu 0.25" in text
+    sample = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+        r' (-?[0-9.eE+-]+|NaN)$')
+    parsed = 0
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE "), line
+            continue
+        m = sample.match(line)
+        assert m, line
+        float(m.group(3))
+        for lab in re.finditer(r'="((?:[^"\\]|\\.)*)"',
+                               m.group(2) or ""):
+            _parse_label_value(lab.group(1))
+        parsed += 1
+    assert parsed >= 5

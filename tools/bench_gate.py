@@ -1,0 +1,246 @@
+#!/usr/bin/env python3
+"""Bench regression gate over the ``bench_history.jsonl`` trajectory.
+
+``bench.py`` appends its compact summary (plus git SHA + timestamp) to
+``bench_history.jsonl`` on every run.  This tool compares a candidate
+run against the trajectory and exits non-zero on regression, so CI can
+gate a change on measured performance:
+
+    python bench.py --quick | tail -1 > cand.json
+    python tools/bench_gate.py --candidate cand.json
+
+Candidate selection, in order: ``--candidate FILE`` (``-`` = stdin);
+``--run`` (invoke a fresh ``bench.py`` — args after ``--`` pass
+through — and take its final stdout line); else the LAST history line
+(gating the most recent run against the ones before it).
+
+Baseline: per metric, the median over the newest ``--window`` prior
+entries that carry it (median, not last — one noisy run must not move
+the bar).  A metric missing from the candidate or from every baseline
+entry is skipped, not failed: bench sections are best-effort and a
+skipped serve smoke must not fail the gate.
+
+Tolerances are per-metric fractions of the baseline (see
+``TOLERANCES``; ``--tolerance`` overrides all).  Direction is per
+metric: throughputs regress downward, latencies/slopes upward.
+
+Exit codes: 0 no regression, 1 regression(s) found, 2 usage/IO error.
+stdlib-only so the gate runs anywhere the history file does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import subprocess
+import sys
+
+# gate metric -> (direction, default tolerance fraction).
+# "higher": regression when candidate < baseline * (1 - tol)
+# "lower":  regression when candidate > baseline * (1 + tol)
+# Nested dict metrics (variant -> number) are flattened to
+# "metric.variant" and inherit the base metric's row.
+GATE_METRICS = {
+    "value": ("higher", 0.30),
+    "batch_sps_median": ("higher", 0.30),
+    "per_sample_dispatch_sps": ("higher", 0.30),
+    "serve_rps": ("higher", 0.40),
+    "slope_us_per_step": ("lower", 0.50),
+    "prod_us_per_step": ("lower", 0.50),
+    "serve_p50_ms": ("lower", 0.60),
+    "serve_p99_ms": ("lower", 1.00),
+    "obs_overhead_pct": ("lower", 2.00),
+}
+
+
+def flatten(entry: dict) -> dict[str, float]:
+    """Project one compact-summary dict onto the gate metrics,
+    flattening nested variant dicts to ``metric.variant``."""
+    flat: dict[str, float] = {}
+    for key in GATE_METRICS:
+        v = entry.get(key)
+        if isinstance(v, dict):
+            for sub, val in sorted(v.items()):
+                if isinstance(val, (int, float)):
+                    flat[f"{key}.{sub}"] = float(val)
+        elif isinstance(v, (int, float)):
+            flat[key] = float(v)
+    return flat
+
+
+def _rule(metric: str) -> tuple[str, float]:
+    base = metric.split(".", 1)[0]
+    return GATE_METRICS[base]
+
+
+def load_history(path: str) -> list[dict]:
+    entries = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line
+            if isinstance(rec, dict):
+                entries.append(rec)
+    return entries
+
+
+def baseline(history: list[dict], window: int) -> dict[str, float]:
+    """Per-metric median over the newest ``window`` entries that
+    carry the metric."""
+    flats = [flatten(e) for e in history]
+    out: dict[str, float] = {}
+    names = {name for f in flats for name in f}
+    for name in names:
+        vals = [f[name] for f in flats if name in f][-window:]
+        if vals:
+            out[name] = statistics.median(vals)
+    return out
+
+
+def gate(cand: dict[str, float], base: dict[str, float],
+         tolerance: float | None = None) -> list[dict]:
+    """Compare candidate metrics against the baseline; returns the
+    regression list (empty = pass)."""
+    regressions = []
+    for name, cval in sorted(cand.items()):
+        bval = base.get(name)
+        if bval is None or bval == 0:
+            continue
+        direction, tol = _rule(name)
+        if tolerance is not None:
+            tol = tolerance
+        if direction == "higher":
+            bad = cval < bval * (1.0 - tol)
+        else:
+            bad = cval > bval * (1.0 + tol)
+        if bad:
+            regressions.append({
+                "metric": name, "candidate": cval, "baseline": bval,
+                "direction": direction, "tolerance": tol,
+                "ratio": cval / bval,
+            })
+    return regressions
+
+
+def _read_candidate(args) -> dict | None:
+    if args.run:
+        cmd = [sys.executable, args.bench] + args.bench_args
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write("bench_gate: bench run failed:\n"
+                             + proc.stderr[-2000:] + "\n")
+            return None
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if not lines:
+            sys.stderr.write("bench_gate: bench produced no output\n")
+            return None
+        try:
+            return json.loads(lines[-1])
+        except json.JSONDecodeError:
+            sys.stderr.write("bench_gate: bench's last stdout line "
+                             "is not the compact JSON summary\n")
+            return None
+    if args.candidate:
+        try:
+            if args.candidate == "-":
+                return json.loads(sys.stdin.read())
+            with open(args.candidate) as fp:
+                return json.load(fp)
+        except (OSError, json.JSONDecodeError) as exc:
+            sys.stderr.write(f"bench_gate: candidate: {exc}\n")
+            return None
+    return {}  # sentinel: take the last history entry
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate a bench run against bench_history.jsonl")
+    ap.add_argument("--history", default="bench_history.jsonl",
+                    help="trajectory JSONL (default "
+                         "bench_history.jsonl)")
+    ap.add_argument("--candidate", metavar="FILE",
+                    help="candidate compact summary JSON "
+                         "('-' = stdin); default: last history line")
+    ap.add_argument("--run", action="store_true",
+                    help="run a fresh bench.py as the candidate "
+                         "(args after -- pass through)")
+    ap.add_argument("--bench", default="bench.py",
+                    help="bench script for --run (default bench.py)")
+    ap.add_argument("--window", type=int, default=5,
+                    help="baseline = per-metric median over the "
+                         "newest N prior entries (default 5)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    metavar="FRAC",
+                    help="override every per-metric tolerance with "
+                         "one fraction (e.g. 0.3)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict as JSON")
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--" in argv:
+        split = argv.index("--")
+        argv, bench_args = argv[:split], argv[split + 1:]
+    else:
+        bench_args = []
+    args = ap.parse_args(argv)
+    args.bench_args = bench_args
+
+    try:
+        history = load_history(args.history)
+    except OSError as exc:
+        sys.stderr.write(f"bench_gate: history: {exc}\n")
+        return 2
+    cand_entry = _read_candidate(args)
+    if cand_entry is None:
+        return 2
+    if not cand_entry:  # default: last history line vs the rest
+        if not history:
+            sys.stderr.write("bench_gate: empty history and no "
+                             "candidate\n")
+            return 2
+        cand_entry, history = history[-1], history[:-1]
+    if not history:
+        sys.stderr.write("bench_gate: no baseline entries — nothing "
+                         "to gate against (pass)\n")
+        return 0
+
+    cand = flatten(cand_entry)
+    base = baseline(history, args.window)
+    regressions = gate(cand, base, tolerance=args.tolerance)
+    verdict = {
+        "pass": not regressions,
+        "baseline_entries": len(history),
+        "metrics_compared": sorted(set(cand) & set(base)),
+        "regressions": regressions,
+    }
+    if args.json:
+        json.dump(verdict, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        n = len(verdict["metrics_compared"])
+        if regressions:
+            sys.stdout.write(f"bench_gate: FAIL — "
+                             f"{len(regressions)} regression(s) over "
+                             f"{n} compared metric(s)\n")
+            for r in regressions:
+                arrow = ("below" if r["direction"] == "higher"
+                         else "above")
+                sys.stdout.write(
+                    f"  {r['metric']}: {r['candidate']:.6g} vs "
+                    f"baseline {r['baseline']:.6g} "
+                    f"({r['ratio']:.2f}x, {arrow} "
+                    f"{r['tolerance']:.0%} tolerance)\n")
+        else:
+            sys.stdout.write(f"bench_gate: PASS — {n} metric(s) "
+                             f"within tolerance\n")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,9 +26,16 @@ hpnn_tpu/obs/ledger.py) is a comparison artifact with a FROZEN row
 schema — ``tools/ledger_diff.py`` and external tooling parse it — so
 any drift is a contract break, not a cosmetic change.
 
+It also carries the performance-attribution schema lint
+(:func:`lint_perf`): ``span.end`` / ``compile.cost`` / ``perf.*``
+records (HPNN_SPANS / HPNN_COST, hpnn_tpu/obs/{spans,cost}.py) feed
+``tools/obs_report.py --spans`` and external dashboards, so their row
+shapes — and the child-inside-parent span nesting the latency tree
+depends on — are checked the same way the ledger rows are.
+
 Run standalone (exit code for CI)::
 
-    python tools/check_obs_catalog.py [--ledger PATH]
+    python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -203,6 +210,156 @@ def lint_ledger(path: str) -> list[str]:
     return failures
 
 
+# the performance-attribution record contracts (obs/spans.py,
+# obs/cost.py; docs/observability.md "Performance attribution")
+SPAN_REQUIRED = {"ts", "ev", "kind", "span", "parent", "name", "t0",
+                 "dt"}
+COST_REQUIRED = {"ts", "ev", "kind", "exe", "units"}
+PERF_GAUGES = ("perf.flops_per_s", "perf.mfu", "perf.bytes_per_s")
+# span t0/dt round to 1 µs on emission; allow that much slack per edge
+# when checking child containment
+_SPAN_EPS = 2e-6
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def lint_perf(path: str) -> list[str]:
+    """Schema-lint the span/cost/perf records of one metrics sink.
+
+    Checks, per record kind:
+
+    * ``span.end`` — required keys present; ``span`` a positive
+      unique int; ``parent`` null or an int; ``t0``/``dt``
+      non-negative numbers; and when the parent span is in the same
+      file, the child's [t0, t0+dt] interval sits inside the
+      parent's (honest nesting is what makes child-sum ≤ parent hold
+      in the report).
+    * ``compile.cost`` — required keys present; ``exe`` a unique
+      string (the catalog is first-call-wins, so a duplicate means
+      double emission); ``flops``/``bytes_accessed`` numbers when
+      present and not an error record; ``units`` a positive int.
+    * ``perf.*`` gauges — ``kind == "gauge"``, finite non-negative
+      ``value``, and an ``exe`` field attributing the rate.
+
+    Other records pass through untouched — the sink interleaves every
+    obs family.  Returns failure strings (empty = pass).
+    """
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    spans: dict[int, dict] = {}
+    span_recs: list[tuple[str, dict]] = []
+    cost_exes: set[str] = set()
+    n_perf = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev == "span.end":
+            missing = SPAN_REQUIRED - set(rec)
+            if missing:
+                failures.append(
+                    f"{at}: span.end missing keys {sorted(missing)}")
+                continue
+            sid = rec["span"]
+            if not isinstance(sid, int) or isinstance(sid, bool) \
+                    or sid < 1:
+                failures.append(
+                    f"{at}: span id {sid!r} is not a positive int")
+                continue
+            if sid in spans:
+                failures.append(f"{at}: span id {sid} emitted twice")
+            parent = rec["parent"]
+            if parent is not None and (not isinstance(parent, int)
+                                       or isinstance(parent, bool)):
+                failures.append(
+                    f"{at}: parent {parent!r} is not null or an int")
+            if not _num(rec["t0"]) or rec["t0"] < 0:
+                failures.append(f"{at}: t0 is not a non-negative "
+                                "number")
+                continue
+            if not _num(rec["dt"]) or rec["dt"] < 0:
+                failures.append(f"{at}: dt is not a non-negative "
+                                "number")
+                continue
+            spans[sid] = rec
+            span_recs.append((at, rec))
+        elif ev == "compile.cost":
+            missing = COST_REQUIRED - set(rec)
+            if missing:
+                failures.append(
+                    f"{at}: compile.cost missing keys "
+                    f"{sorted(missing)}")
+                continue
+            exe = rec["exe"]
+            if not isinstance(exe, str) or not exe:
+                failures.append(f"{at}: exe is not a string")
+                continue
+            if exe in cost_exes:
+                failures.append(
+                    f"{at}: duplicate compile.cost for exe {exe!r} "
+                    "(the catalog is first-call-wins)")
+            cost_exes.add(exe)
+            units = rec["units"]
+            if not isinstance(units, int) or isinstance(units, bool) \
+                    or units < 1:
+                failures.append(
+                    f"{at}: units {units!r} is not a positive int")
+            if "error" not in rec:
+                for key in ("flops", "bytes_accessed"):
+                    v = rec.get(key)
+                    if v is not None and not _num(v):
+                        failures.append(
+                            f"{at}: {key} {v!r} is not a number")
+        elif isinstance(ev, str) and ev.startswith("perf."):
+            n_perf += 1
+            if rec.get("kind") != "gauge":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'gauge'")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a finite "
+                    "non-negative number")
+            if "exe" not in rec:
+                failures.append(
+                    f"{at}: {ev} has no exe field — the rate is "
+                    "unattributable")
+    # nesting: a child whose parent finished in this file must sit
+    # inside the parent's interval (both clocks are the same
+    # time.perf_counter, so the comparison is meaningful)
+    for at, rec in span_recs:
+        parent = spans.get(rec["parent"])
+        if parent is None:
+            continue
+        lo = parent["t0"] - _SPAN_EPS
+        hi = parent["t0"] + parent["dt"] + _SPAN_EPS
+        if rec["t0"] < lo or rec["t0"] + rec["dt"] > hi:
+            failures.append(
+                f"{at}: span {rec['span']} ({rec['name']!r}) "
+                f"[{rec['t0']}, {rec['t0'] + rec['dt']}] escapes "
+                f"parent {rec['parent']} "
+                f"[{parent['t0']}, {parent['t0'] + parent['dt']}]")
+    if not spans and not cost_exes and not n_perf:
+        failures.append(
+            f"sink {path!r} has no span.end / compile.cost / perf.* "
+            "records — were HPNN_SPANS / HPNN_COST set?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -213,6 +370,12 @@ def main(argv: list[str] | None = None) -> int:
             sys.stderr.write("check_obs_catalog: --ledger needs a path\n")
             return 2
         failures += lint_ledger(argv[i + 1])
+    if "--perf" in argv:
+        i = argv.index("--perf")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --perf needs a path\n")
+            return 2
+        failures += lint_perf(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -19,7 +19,8 @@ unset, once with it pointed at a JSONL sink — and asserts
 The instrumented run enables the whole surface at once — JSONL sink,
 flight-recorder ring (``HPNN_FLIGHT``), device telemetry, numerics
 probes + sentinel + checksum ledger (``HPNN_PROBES`` /
-``HPNN_NUMERICS`` / ``HPNN_LEDGER``), and a live export server whose
+``HPNN_NUMERICS`` / ``HPNN_LEDGER``), lifecycle spans + compiled-cost
+attribution (``HPNN_SPANS`` / ``HPNN_COST``), and a live export server whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
 minimal one.  A final ledger-only run proves the probes are
@@ -147,19 +148,21 @@ def check(tmpdir: str) -> list[str]:
     os.environ["HPNN_PROBES"] = "1"
     os.environ["HPNN_NUMERICS"] = "warn"
     os.environ["HPNN_LEDGER"] = ledger_b
+    os.environ["HPNN_SPANS"] = "1"
+    os.environ["HPNN_COST"] = "1"
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
     finally:
         for knob in ("HPNN_FLIGHT", "HPNN_PROBES", "HPNN_NUMERICS",
-                     "HPNN_LEDGER"):
+                     "HPNN_LEDGER", "HPNN_SPANS", "HPNN_COST"):
             os.environ.pop(knob, None)
 
     if plain != instrumented:
         failures.append(
             "stdout is NOT byte-identical with HPNN_METRICS + "
             "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
-            "export server all enabled "
+            "HPNN_SPANS + HPNN_COST + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
     body = scraped.get("metrics", "")
     if "# TYPE" not in body or "hpnn_" not in body:
@@ -249,7 +252,8 @@ def check(tmpdir: str) -> list[str]:
     for want in ("round.start", "driver.chunk_dispatch", "train.n_iter",
                  "fuse.chunk_size", "round.end", "obs.summary",
                  "device.live_arrays", "numerics.probe",
-                 "numerics.checksum"):
+                 "numerics.checksum", "span.end", "compile.cost",
+                 "perf.flops_per_s"):
         if want not in names:
             failures.append(f"metrics sink missing event {want!r}")
     return failures

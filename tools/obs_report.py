@@ -5,6 +5,7 @@ Usage::
 
     python tools/obs_report.py run.metrics.jsonl          # text report
     python tools/obs_report.py run.metrics.jsonl --json   # machine form
+    python tools/obs_report.py run.metrics.jsonl --spans  # span tree
     python tools/obs_report.py --merge run.0.jsonl run.1.jsonl \
         [--out merged.jsonl]                              # cross-rank
 
@@ -169,6 +170,104 @@ def summarize(events: list[dict]) -> dict:
     return rep
 
 
+_SPAN_META = ("ts", "ev", "kind", "span", "parent", "name", "t0", "dt")
+
+
+def collect_spans(events: list[dict]) -> list[dict]:
+    """Pull the ``span.end`` records (HPNN_SPANS) out of the stream.
+
+    Each span carries its own id, its parent id (or None for a root),
+    a monotonic start ``t0`` and duration ``dt``.  Returned in ``t0``
+    order so the tree renders in wall-clock order.
+    """
+    spans = []
+    for rec in events:
+        if rec.get("ev") != "span.end":
+            continue
+        spans.append({
+            "span": rec.get("span"),
+            "parent": rec.get("parent"),
+            "name": rec.get("name", "?"),
+            "t0": float(rec.get("t0", 0.0)),
+            "dt": float(rec.get("dt", 0.0)),
+            "fields": {k: v for k, v in rec.items()
+                       if k not in _SPAN_META},
+        })
+    spans.sort(key=lambda s: s["t0"])
+    return spans
+
+
+def span_tree(spans: list[dict]) -> list[dict]:
+    """Arrange spans into root trees (children nested under parents).
+
+    A span whose parent id never finished (e.g. a truncated sink) is
+    promoted to a root rather than dropped.  Children stay in ``t0``
+    order.  Returns the list of roots; each node gains ``children``
+    and ``child_s`` (the sum of its direct children's durations — by
+    construction ≤ the parent's own ``dt`` when nesting is honest,
+    which is what the report lets you eyeball).
+    """
+    by_id = {s["span"]: s for s in spans if s["span"] is not None}
+    roots: list[dict] = []
+    for s in spans:
+        s.setdefault("children", [])
+        parent = by_id.get(s["parent"])
+        if parent is None or parent is s:
+            roots.append(s)
+        else:
+            parent.setdefault("children", []).append(s)
+    for s in spans:
+        s["child_s"] = sum(c["dt"] for c in s["children"])
+    return roots
+
+
+def _render_span_node(w, node: dict, depth: int) -> None:
+    pad = "  " * depth
+    extra = ""
+    if node["children"]:
+        extra = (f"  (children {node['child_s']:.6f}s,"
+                 f" self {max(node['dt'] - node['child_s'], 0.0):.6f}s)")
+    fields = ", ".join(f"{k}={v}" for k, v in
+                       sorted(node["fields"].items()))
+    w(f"  {pad}{node['name']:<{max(28 - 2 * depth, 8)}s}"
+      f" {node['dt']:10.6f}s{extra}"
+      + (f"  [{fields}]" if fields else ""))
+    for child in node["children"]:
+        _render_span_node(w, child, depth + 1)
+
+
+def render_spans(events: list[dict], top: int = 10) -> str:
+    """The --spans report: latency-breakdown tree + slowest-N table.
+
+    The tree nests each span under its parent so queue wait
+    (``serve.queue``) reads separately from device time
+    (``serve.dispatch``) inside one ``serve.request``, and each parent
+    shows its children-sum vs. self time.
+    """
+    spans = collect_spans(events)
+    out: list[str] = []
+    w = out.append
+    w("== span report ==")
+    if not spans:
+        w("  (no span.end records — was HPNN_SPANS set?)")
+        return "\n".join(out) + "\n"
+    w(f"spans: {len(spans)}")
+    w("")
+    w("-- latency tree (t0 order; dt seconds) --")
+    for root in span_tree(spans):
+        _render_span_node(w, root, 0)
+    w("")
+    w(f"-- slowest {min(top, len(spans))} --")
+    w(f"  {'name':28s} {'dt_s':>10s} {'span':>6s} {'parent':>6s}")
+    for s in sorted(spans, key=lambda s: -s["dt"])[:top]:
+        parent = "-" if s["parent"] is None else str(s["parent"])
+        flag = (f"  FAILED({s['fields']['failed']})"
+                if s["fields"].get("failed") else "")
+        w(f"  {s['name']:28s} {s['dt']:10.6f} {str(s['span']):>6s}"
+          f" {parent:>6s}{flag}")
+    return "\n".join(out) + "\n"
+
+
 def _bar(count: int, peak: int, width: int = 30) -> str:
     if peak <= 0:
         return ""
@@ -279,6 +378,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="metrics JSONL file (several with --merge)")
     ap.add_argument("--json", action="store_true",
                     help="print the report as JSON instead of text")
+    ap.add_argument("--spans", action="store_true",
+                    help="render the HPNN_SPANS latency-breakdown "
+                         "tree and slowest-N table instead of the "
+                         "aggregate report")
+    ap.add_argument("--top", type=int, default=10, metavar="N",
+                    help="with --spans: rows in the slowest table "
+                         "(default 10)")
     ap.add_argument("--merge", action="store_true",
                     help="join several {rank}-expanded sinks into one "
                          "cross-rank timeline (skew-tolerant ordering)")
@@ -300,6 +406,14 @@ def main(argv: list[str] | None = None) -> int:
     except OSError as exc:
         sys.stderr.write(f"obs_report: {exc}\n")
         return 1
+    if args.spans:
+        if args.json:
+            json.dump(collect_spans(events), sys.stdout, indent=2,
+                      default=str)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_spans(events, top=args.top))
+        return 0
     rep = summarize(events)
     if args.merge:
         ranks: dict = {}
