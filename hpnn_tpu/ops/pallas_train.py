@@ -347,30 +347,28 @@ def train_epoch_fused(
 # ---------------------------------------------------------------------------
 
 
-def _batch_step_kernel(
-    x_ref,
-    t_ref,
-    *refs,
+def _batch_step_math(
+    x,
+    t,
+    w,
+    dw,
+    acts,
+    ds,
+    loss_ref,
+    slot,
+    *,
     n_layers: int,
     model: str,
     momentum: bool,
     lr: float,
     alpha: float,
     inv_b: float,
-    loss_at_program_id: bool = False,
 ):
-    # ref layout: [aliased input state refs (ignored), output state
-    # refs, loss ref, then scratch: acts and deltas per layer]
-    n_state = n_layers * (2 if momentum else 1)
-    out_state = refs[n_state : 2 * n_state]
-    w = list(out_state[:n_layers])
-    dw = list(out_state[n_layers:]) if momentum else []
-    loss_ref = refs[2 * n_state]
-    acts = list(refs[2 * n_state + 1 : 2 * n_state + 1 + n_layers])
-    ds = list(refs[2 * n_state + 1 + n_layers : 2 * n_state + 1 + 2 * n_layers])
-
-    x = x_ref[:]
-    t = t_ref[:]
+    """The batch-step math on VALUES ``x``/``t`` (weights stay refs,
+    updated in place; ``slot`` indexes the per-step loss output).
+    Shared by the block-spec kernels below — where Pallas's implicit
+    grid pipeline delivers x/t — and the explicit double-buffered DMA
+    epoch (:func:`train_epoch_dbuf_banked`), which loads them itself."""
     if model == "snn":
         # batch mode reads the ±1 container one-hots as 0/1
         # (dp.sample_loss's clamp — see its comment)
@@ -424,7 +422,6 @@ def _batch_step_kernel(
             w[l][:] = w[l][:] + (lr * inv_b) * outer
     # post-update loss, like train_step_math's re-forward; the grid
     # epoch kernel writes each step's slot of the (S,) SMEM output
-    slot = pl.program_id(0) if loss_at_program_id else 0
     forward()
     if model == "snn":
         o = acts[-1][:]
@@ -433,6 +430,46 @@ def _batch_step_kernel(
     else:
         d = t - acts[-1][:]
         loss_ref[slot] = 0.5 * jnp.sum(d * d) * inv_b
+
+
+def _batch_step_kernel(
+    x_ref,
+    t_ref,
+    *refs,
+    n_layers: int,
+    model: str,
+    momentum: bool,
+    lr: float,
+    alpha: float,
+    inv_b: float,
+    loss_at_program_id: bool = False,
+):
+    # ref layout: [aliased input state refs (ignored), output state
+    # refs, loss ref, then scratch: acts and deltas per layer]
+    n_state = n_layers * (2 if momentum else 1)
+    out_state = refs[n_state : 2 * n_state]
+    w = list(out_state[:n_layers])
+    dw = list(out_state[n_layers:]) if momentum else []
+    loss_ref = refs[2 * n_state]
+    acts = list(refs[2 * n_state + 1 : 2 * n_state + 1 + n_layers])
+    ds = list(refs[2 * n_state + 1 + n_layers : 2 * n_state + 1 + 2 * n_layers])
+
+    _batch_step_math(
+        x_ref[:],
+        t_ref[:],
+        w,
+        dw,
+        acts,
+        ds,
+        loss_ref,
+        pl.program_id(0) if loss_at_program_id else 0,
+        n_layers=n_layers,
+        model=model,
+        momentum=momentum,
+        lr=lr,
+        alpha=alpha,
+        inv_b=inv_b,
+    )
 
 
 @functools.partial(
@@ -697,6 +734,159 @@ def train_epoch_grid_banked(
         kernel,
         out_shape=out_shape,
         grid_spec=grid_spec,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(jnp.asarray(order, dtype=jnp.int32), X_bank, T_bank, *state)
+    new_w = tuple(results[:n_layers])
+    new_dw = tuple(results[n_layers : 2 * n_layers]) if momentum else ()
+    return new_w, new_dw, results[n_state]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("batch", "model", "momentum", "lr", "alpha",
+                              "interpret")
+)
+def train_epoch_dbuf_banked(
+    weights,
+    dw,
+    X_bank,
+    T_bank,
+    order,
+    *,
+    batch: int,
+    model: str = "ann",
+    momentum: bool = False,
+    lr: float | None = None,
+    alpha: float = 0.2,
+    interpret: bool = False,
+):
+    """The banked epoch with EXPLICIT double-buffered HBM→VMEM DMA.
+
+    :func:`train_epoch_grid_banked` leans on the implicit grid
+    pipeline: Mosaic prefetches step ``i+1``'s (B, n) block while step
+    ``i`` computes, but the schedule is the compiler's.  This variant
+    owns the pipeline instead — the X/T banks stay HBM-resident
+    (``memory_space=ANY``), the kernel runs as a single program with a
+    ``fori_loop`` over the S steps, and each step:
+
+    1. starts the ASYNC copy of block ``order[step+1]`` into the spare
+       VMEM slot (2-slot rotation, one DMA semaphore per slot per
+       operand) — so the next block streams while this one computes;
+    2. waits only on its OWN slot's semaphore (the warm-up copy for
+       step 0 was started before the loop);
+    3. runs the exact :func:`_batch_step_math` update on the resident
+       slot.
+
+    Same signature/semantics as :func:`train_epoch_grid_banked`
+    (parity-tested in interpret mode); weights stay VMEM-resident
+    across all S steps via the aliased state refs.  Opt-in from the
+    batch driver via ``HPNN_BANK_DBUF=1`` (train/batch.py); the VMEM
+    budget gate already charges the double-buffered next block
+    (``fused_vmem_bytes``'s bank term).
+
+    order: (S,) int32 block ids.  Returns (weights, dw, losses[S]).
+    """
+    n_layers = len(weights)
+    if lr is None:
+        from hpnn_tpu.parallel import dp
+
+        lr = dp.default_lr(model, momentum)
+    weights = tuple(jnp.asarray(wl, dtype=_F32) for wl in weights)
+    dw = tuple(jnp.asarray(m, dtype=_F32) for m in dw) if momentum else ()
+    X_bank = jnp.asarray(X_bank, dtype=_F32)
+    T_bank = jnp.asarray(T_bank, dtype=_F32)
+    B = int(batch)
+    S = int(order.shape[0])
+    n_in = X_bank.shape[1]
+    n_out = T_bank.shape[1]
+
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    hbm = pl.BlockSpec(memory_space=pltpu.ANY)
+    n_state = n_layers * (2 if momentum else 1)
+    state = tuple(weights) + tuple(dw)
+
+    out_shape = (
+        tuple(jax.ShapeDtypeStruct(wl.shape, _F32) for wl in weights)
+        + (tuple(jax.ShapeDtypeStruct(m.shape, _F32) for m in dw)
+           if momentum else ())
+        + (jax.ShapeDtypeStruct((S,), _F32),)  # per-step losses
+    )
+    # inputs: (order, X_bank, T_bank, state...) — state starts at 3
+    aliases = {3 + i: i for i in range(n_state)}
+
+    def kernel(ord_ref, x_hbm, t_hbm, *refs):
+        out_state = refs[n_state : 2 * n_state]
+        w = list(out_state[:n_layers])
+        dwr = list(out_state[n_layers:]) if momentum else []
+        loss_ref = refs[2 * n_state]
+        acts = list(refs[2 * n_state + 1 : 2 * n_state + 1 + n_layers])
+        ds = list(refs[2 * n_state + 1 + n_layers
+                       : 2 * n_state + 1 + 2 * n_layers])
+
+        def scoped(xbuf, tbuf, sem_x, sem_t):
+            def copies(slot, step):
+                blk = ord_ref[step]
+                return (
+                    pltpu.make_async_copy(
+                        x_hbm.at[pl.ds(blk * B, B)], xbuf.at[slot],
+                        sem_x.at[slot]),
+                    pltpu.make_async_copy(
+                        t_hbm.at[pl.ds(blk * B, B)], tbuf.at[slot],
+                        sem_t.at[slot]),
+                )
+
+            # warm-up: block order[0] into slot 0 before the loop
+            for c in copies(0, 0):
+                c.start()
+
+            def body(step, carry):
+                cur = lax.rem(step, 2)
+                nxt = lax.rem(step + 1, 2)
+
+                @pl.when(step + 1 < S)
+                def _():
+                    for c in copies(nxt, step + 1):
+                        c.start()
+
+                for c in copies(cur, step):
+                    c.wait()
+                _batch_step_math(
+                    xbuf[cur],
+                    tbuf[cur],
+                    w,
+                    dwr,
+                    acts,
+                    ds,
+                    loss_ref,
+                    step,
+                    n_layers=n_layers,
+                    model=model,
+                    momentum=momentum,
+                    lr=float(lr),
+                    alpha=float(alpha),
+                    inv_b=1.0 / B,
+                )
+                return carry
+
+            lax.fori_loop(0, S, body, 0)
+
+        pl.run_scoped(
+            scoped,
+            xbuf=pltpu.VMEM((2, B, n_in), _F32),
+            tbuf=pltpu.VMEM((2, B, n_out), _F32),
+            sem_x=pltpu.SemaphoreType.DMA((2,)),
+            sem_t=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    results = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[smem, hbm, hbm] + [vmem] * n_state,
+        out_specs=tuple(vmem for _ in range(n_state)) + (smem,),
+        scratch_shapes=[
+            pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights
+        ] + [pltpu.VMEM((B, wl.shape[0]), _F32) for wl in weights],
         input_output_aliases=aliases,
         interpret=interpret,
     )(jnp.asarray(order, dtype=jnp.int32), X_bank, T_bank, *state)

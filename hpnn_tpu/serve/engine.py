@@ -46,6 +46,7 @@ is asserted identically.  jax is imported lazily inside the class so
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 import time
@@ -58,6 +59,17 @@ from hpnn_tpu.serve.registry import Entry, Registry
 DEFAULT_MAX_BATCH = 64
 DEFAULT_N_BUCKETS = 4
 _MODES = ("parity", "compiled")
+
+
+def fleet_key(entry: Entry) -> tuple:
+    """The grouping key for fleet dispatch: two kernels can share one
+    stacked executable iff they agree on (model, layer shapes, dtype).
+    Version is deliberately absent — the fleet CACHE key carries each
+    member's version, so a hot-reload regroups transparently."""
+    shapes = tuple(tuple(int(d) for d in np.asarray(w).shape)
+                   for w in entry.kernel.weights)
+    return (entry.model, shapes,
+            np.asarray(entry.kernel.weights[0]).dtype.str)
 
 
 def bucket_menu(max_batch: int = DEFAULT_MAX_BATCH,
@@ -280,6 +292,13 @@ class Engine:
             bucket = bucket_for(self.buckets, n)
             obs.count("serve.bucket_hit", kernel=entry.name,
                       bucket=bucket, rows=n)
+            # pad-waste: fraction of the bucket's rows that are zero
+            # padding (compiled mode pads; parity runs exact rows) —
+            # the /metrics signal for data-driven bucket/fleet sizing
+            obs.gauge("serve.pad_waste",
+                      0.0 if self.mode == "parity"
+                      else (bucket - n) / bucket,
+                      kernel=entry.name, bucket=bucket, rows=n)
             fn = self._compiled_forward(entry, bucket, dtype)
             if self.mode == "compiled" and n < bucket:
                 block = np.zeros((bucket, entry.n_inputs), dtype=dtype)
@@ -326,6 +345,183 @@ class Engine:
         for c in counts:
             results.append(out[start:start + c])
             start += c
+        return results
+
+    # ------------------------------------------------------------ fleet
+    def _fleet_forward(self, entries: tuple, bucket: int, dtype):
+        """The cached fleet executable for a same-topology member set:
+        one program answering all N members' padded blocks at once.
+
+        compiled mode: the members' weights are stacked along a
+        leading axis and the per-sample forward is vmapped over
+        (member, row) — an AOT ``(N, bucket, n_in) -> (N, bucket,
+        n_out)`` executable, cataloged under a stable
+        ``serve.fleet.*`` identity for the ``perf.mfu`` family.
+        parity mode: a closure running each member's EXACT rows
+        through that member's per-kernel parity closure
+        (:meth:`_compiled_forward`), so fleet answers are bitwise
+        equal to per-kernel ``dispatch`` — the parity proof the fleet
+        tests assert."""
+        import jax
+
+        dtype = np.dtype(dtype)
+        key = (("fleet",)
+               + tuple((e.name, e.version) for e in entries),
+               bucket, dtype.str)
+        with self._lock:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                self._stat(key)["hits"] += 1
+                return fn
+        first = entries[0]
+        if first.model == "snn":
+            from hpnn_tpu.models import snn as model
+        else:
+            from hpnn_tpu.models import ann as model
+
+        t_fill = time.perf_counter()
+        if self.mode == "parity":
+            members = [self._compiled_forward(e, bucket, dtype)
+                       for e in entries]
+
+            def fn(blocks, _members=members):
+                return [np.asarray(m(b))
+                        for m, b in zip(_members, blocks)]
+        else:
+            import jax.numpy as jnp
+
+            stacked = tuple(
+                jnp.stack([jnp.asarray(np.asarray(e.kernel.weights[l]))
+                           for e in entries])
+                for l in range(len(first.kernel.weights)))
+
+            def fleet_forward(xs):
+                member = jax.vmap(
+                    lambda w, xb: jax.vmap(
+                        lambda x: model.run(w, x))(xb))
+                return member(stacked, xs)
+
+            donate = () if jax.default_backend() == "cpu" else (0,)
+            shape = jax.ShapeDtypeStruct(
+                (len(entries), bucket, first.n_inputs), dtype)
+            with obs.timer("serve.compile_time", kernel="(fleet)",
+                           bucket=bucket, members=len(entries)):
+                with jax.default_matmul_precision("float32"):
+                    fn = (jax.jit(fleet_forward, donate_argnums=donate)
+                          .lower(shape).compile())
+        fill_s = time.perf_counter() - t_fill
+        if self.mode == "compiled":
+            obs.cost.note_executable(
+                self._fleet_exe_name(key), fn,
+                units=len(entries) * bucket, compile_s=fill_s,
+                members=len(entries), bucket=bucket, mode=self.mode)
+        obs.count("serve.compile", kernel="(fleet)",
+                  members=len(entries), bucket=bucket, dtype=dtype.str,
+                  mode=self.mode)
+        with self._lock:
+            self._compiled[key] = fn
+            stat = self._stat(key)
+            stat["misses"] += 1
+            stat["compile_s"] += fill_s
+        return fn
+
+    @staticmethod
+    def _fleet_exe_name(key: tuple) -> str:
+        members, bucket, dtype_str = key
+        sig = hashlib.md5(repr(members[1:]).encode()).hexdigest()[:8]
+        return f"serve.fleet.n{len(members) - 1}.b{bucket}.{sig}"
+
+    def dispatch_fleet(self, payloads) -> list[np.ndarray]:
+        """Fleet batcher dispatch hook: ``payloads`` is a list of
+        ``(kernel_name, rows)`` pairs from MANY kernels.  Names are
+        grouped by :func:`fleet_key`; every group with ≥ 2 distinct
+        same-topology kernels is answered by ONE coalesced fleet
+        executable (each member padded to the group's common bucket),
+        and singleton groups fall back to the per-kernel
+        :meth:`dispatch` path.  Returns one result per payload, in
+        payload order."""
+        named = []
+        for name, rows in payloads:
+            named.append((name, np.atleast_2d(np.asarray(rows))))
+        groups: dict[tuple, list[int]] = {}
+        entries = {}
+        for i, (name, _rows) in enumerate(named):
+            if name not in entries:
+                entries[name] = self.registry.get(name)
+            groups.setdefault(fleet_key(entries[name]), []).append(i)
+        results: list = [None] * len(named)
+        top = self.buckets[-1]
+        for idxs in groups.values():
+            # member order: first appearance of each kernel name
+            by_name: dict[str, list[int]] = {}
+            for i in idxs:
+                by_name.setdefault(named[i][0], []).append(i)
+            rows_for = {
+                name: np.concatenate([named[i][1] for i in ixs])
+                for name, ixs in by_name.items()}
+            max_rows = max(r.shape[0] for r in rows_for.values())
+            if len(by_name) < 2 or max_rows > top:
+                # singleton topology — or a member too big for the
+                # bucket menu (the per-kernel path chunks, the fixed
+                # (N, bucket) fleet block cannot): per-kernel dispatch
+                for name, ixs in by_name.items():
+                    outs = self.dispatch(
+                        name, [named[i][1] for i in ixs])
+                    for i, out in zip(ixs, outs):
+                        results[i] = out
+                continue
+            members = sorted(by_name)  # stable member order
+            ents = tuple(entries[m] for m in members)
+            bucket = bucket_for(self.buckets, max_rows)
+            n = len(members)
+            dtype = np.asarray(
+                ents[0].kernel.weights[0]).dtype
+            obs.gauge("fleet.size", n, where="serve")
+            obs.count("serve.fleet_group", members=n, bucket=bucket,
+                      rows=int(sum(r.shape[0]
+                                   for r in rows_for.values())))
+            fn = self._fleet_forward(ents, bucket, dtype)
+            with obs.spans.span("serve.fleet_dispatch", members=n,
+                                bucket=bucket):
+                if self.mode == "parity":
+                    blocks = [rows_for[m].astype(dtype, copy=False)
+                              for m in members]
+                    outs = fn(blocks)
+                else:
+                    stackb = np.zeros(
+                        (n, bucket, ents[0].n_inputs), dtype=dtype)
+                    for j, m in enumerate(members):
+                        r = rows_for[m]
+                        stackb[j, :r.shape[0]] = r
+                    if obs.cost.enabled():
+                        t0 = time.perf_counter()
+                        res = np.asarray(fn(stackb))
+                        obs.cost.record_dispatch(
+                            self._fleet_exe_name(
+                                (("fleet",)
+                                 + tuple((e.name, e.version)
+                                         for e in ents),
+                                 bucket, dtype.str)),
+                            time.perf_counter() - t0)
+                    else:
+                        res = np.asarray(fn(stackb))
+                    outs = [res[j, :rows_for[m].shape[0]]
+                            for j, m in enumerate(members)]
+            for m, out in zip(members, outs):
+                got = rows_for[m].shape[0]
+                obs.gauge("serve.pad_waste",
+                          0.0 if self.mode == "parity"
+                          else (bucket - got) / bucket,
+                          kernel=m, bucket=bucket, rows=got,
+                          fleet=True)
+                if obs.probes.enabled():
+                    obs.probes.note_serve(
+                        m, rows=got, nan=int(np.isnan(out).sum()))
+                start = 0
+                for i in by_name[m]:
+                    c = named[i][1].shape[0]
+                    results[i] = out[start:start + c]
+                    start += c
         return results
 
     # ------------------------------------------------------------ misc

@@ -28,6 +28,7 @@ runs inside a driver process.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -50,18 +51,30 @@ class Session:
     ``start=False`` runs with no drain threads (tests step batchers
     by hand via ``batcher_for(name).drain_once()``); ``clock`` is
     forwarded to the batchers for fake-clock tests.
+
+    ``fleet=True`` (or ``HPNN_SERVE_FLEET=1``) routes every kernel
+    through ONE shared batcher whose dispatch hook is
+    ``engine.dispatch_fleet``: requests for different same-topology
+    kernels coalesce into one stacked executable per drain, and
+    mixed/singleton topologies transparently fall back to the
+    per-kernel path inside the hook (docs/fleet.md).
     """
+
+    FLEET_BATCHER = "(fleet)"
 
     def __init__(self, *, max_batch: int = DEFAULT_MAX_BATCH,
                  n_buckets: int = DEFAULT_N_BUCKETS,
                  max_wait_ms: float = 2.0, max_depth: int = 256,
                  clock=time.monotonic, start: bool = True,
-                 mode: str | None = None):
+                 mode: str | None = None, fleet: bool | None = None):
         self.registry = Registry()
         self.engine = Engine(self.registry, max_batch=max_batch,
                              n_buckets=n_buckets, mode=mode)
         self.max_wait_ms = float(max_wait_ms)
         self.max_depth = int(max_depth)
+        if fleet is None:
+            fleet = os.environ.get("HPNN_SERVE_FLEET", "") == "1"
+        self.fleet = bool(fleet)
         self._clock = clock
         self._start = bool(start)
         self._lock = threading.Lock()
@@ -130,19 +143,32 @@ class Session:
     # ------------------------------------------------------------ infer
     def batcher_for(self, name: str) -> Batcher:
         self.registry.get(name)  # KeyError for unknown kernels
+        bname = self.FLEET_BATCHER if self.fleet else name
         with self._lock:
             if self._closed:
                 raise RuntimeError("session is closed")
-            b = self._batchers.get(name)
+            b = self._batchers.get(bname)
             if b is None:
-                b = Batcher(
-                    lambda payloads, _n=name: self.engine.dispatch(
-                        _n, payloads),
-                    max_batch=self.engine.max_batch,
-                    max_wait_ms=self.max_wait_ms,
-                    max_depth=self.max_depth,
-                    clock=self._clock, name=name, start=self._start)
-                self._batchers[name] = b
+                if self.fleet:
+                    # ONE queue for every kernel: payloads carry their
+                    # kernel name and the hook groups by topology
+                    b = Batcher(
+                        self.engine.dispatch_fleet,
+                        max_batch=self.engine.max_batch,
+                        max_wait_ms=self.max_wait_ms,
+                        max_depth=self.max_depth,
+                        clock=self._clock, name=bname,
+                        start=self._start)
+                else:
+                    b = Batcher(
+                        lambda payloads, _n=name: self.engine.dispatch(
+                            _n, payloads),
+                        max_batch=self.engine.max_batch,
+                        max_wait_ms=self.max_wait_ms,
+                        max_depth=self.max_depth,
+                        clock=self._clock, name=name,
+                        start=self._start)
+                self._batchers[bname] = b
         return b
 
     def infer(self, name: str, x, *, timeout_s: float = 5.0):
@@ -157,6 +183,7 @@ class Session:
         single = arr.ndim == 1
         rows = np.atleast_2d(arr)
         batcher = self.batcher_for(name)
+        payload = (name, rows) if self.fleet else rows
         # root of the request lifecycle: serve.queue / serve.dispatch
         # children hang off it across the batcher threads (HPNN_SPANS)
         span = obs.spans.start("serve.request", kernel=name,
@@ -164,7 +191,7 @@ class Session:
         try:
             with obs.timer("serve.request", kernel=name,
                            rows=rows.shape[0]):
-                out = batcher.infer(rows, rows=rows.shape[0],
+                out = batcher.infer(payload, rows=rows.shape[0],
                                     timeout_s=timeout_s, span=span)
         except BaseException as exc:
             obs.spans.finish(span, failed=type(exc).__name__)

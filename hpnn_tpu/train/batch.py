@@ -227,6 +227,11 @@ def make_multi_epoch_bank_fn(step_fn, count_fn, n_steps: int, *,
     epoch as one Mosaic launch (pallas_train.train_epoch_grid_banked
     — block fetches pipelined behind compute, weights VMEM-resident
     across steps; +28% paired over the per-step-launch variant).
+    ``banked="dbuf"``: same call convention, but step_fn is the
+    explicit double-buffered DMA epoch
+    (pallas_train.train_epoch_dbuf_banked — the kernel owns the
+    HBM→VMEM pipeline instead of the implicit grid prefetch;
+    HPNN_BANK_DBUF=1, paired delta reported by tools/bench_bank.py).
     ``banked=True``: step_fn(w, m, Xp, Tp, k) is the per-step Pallas
     kernel reading block ``k`` straight from the HBM bank via a
     scalar-prefetched index_map (pallas_train.train_step_fused_banked)
@@ -248,7 +253,7 @@ def make_multi_epoch_bank_fn(step_fn, count_fn, n_steps: int, *,
 
             def epoch(c, ord_e):
                 w2, m2 = c
-                if banked == "grid":
+                if banked in ("grid", "dbuf"):
                     w2, m2, losses = step_fn(w2, m2, Xp, Tp, ord_e)
                     return (w2, m2), (losses, count_fn(w2, X, T))
 
@@ -431,6 +436,11 @@ def train_kernel_batched(
         max(1, int(os.environ.get("HPNN_BANK_REFRESH", "8")))
         if use_bank else 0
     )
+    # HPNN_BANK_DBUF=1 swaps the grid-epoch kernel for the explicit
+    # double-buffered DMA epoch (pallas_train.train_epoch_dbuf_banked)
+    # — same math, kernel-owned HBM→VMEM pipeline; opt-in until the
+    # paired bench (tools/bench_bank.py dbufR-vs-bankR) crowns it
+    use_dbuf = use_bank and os.environ.get("HPNN_BANK_DBUF", "") == "1"
     # Fused Pallas batch step: default for ANN, opt-in for SNN — the
     # r05 paired slope measurements at realistic bank sizes
     # (BASELINE.md): on the bank path the kernel matches XLA at the
@@ -488,9 +498,15 @@ def train_kernel_batched(
 
                 if use_bank:
                     # the grid-epoch kernel: one Mosaic launch per
-                    # epoch (+28% paired over per-step launches, r05)
+                    # epoch (+28% paired over per-step launches, r05);
+                    # HPNN_BANK_DBUF=1 selects the explicit
+                    # double-buffered DMA twin instead
+                    epoch_kernel = (
+                        pallas_train.train_epoch_dbuf_banked if use_dbuf
+                        else pallas_train.train_epoch_grid_banked)
+
                     def step_fn(w, m, Xp, Tp, ord_e):
-                        return pallas_train.train_epoch_grid_banked(
+                        return epoch_kernel(
                             w, m, Xp, Tp, ord_e, batch=B, model=model,
                             momentum=momentum, lr=lr, alpha=0.2,
                         )
@@ -505,7 +521,8 @@ def train_kernel_batched(
             if use_bank:
                 return make_multi_epoch_bank_fn(
                     step_fn, count_fn, n_steps,
-                    banked="grid" if with_pallas else False,
+                    banked=(("dbuf" if use_dbuf else "grid")
+                            if with_pallas else False),
                 )
             return make_multi_epoch_fn(step_fn, count_fn)
 
@@ -568,6 +585,7 @@ def train_kernel_batched(
             tuple(tuple(int(d) for d in w.shape) for w in weights),
             B, lr, epochs,
             ("pallas" if with_pallas else "xla")
+            + ("-dbuf" if (with_pallas and use_dbuf) else "")
             + (f"-bank{bank_refresh}/" if use_bank else "/")
             + _init_identity(conf, [np.asarray(w) for w in weights]),
             names=names,

@@ -1,0 +1,337 @@
+"""Fleet execution: train N same-topology kernels in ONE dispatch.
+
+libhpnn's natural users run *many small* fully-connected kernels
+alongside a scientific calculation (PAPER.md §0) — an ensemble of
+HPNN-sized networks, not one big net.  Dispatching them one at a time
+leaves the batch path dispatch-bound (~20 us/step where the math is a
+few us, BENCH_r05).  This module amortizes that overhead across the
+workload's real shape: the members' weights are stacked along a
+leading axis and the whole fleet trains as one ``jax.vmap``-ped
+program — one compile, one dispatch, N trajectories.
+
+Semantics
+---------
+
+* **Same topology required.**  Members must share layer shapes and
+  dtype (:func:`stack_kernels` validates); mixed-topology populations
+  are the serve layer's problem (``engine.dispatch_fleet`` groups by
+  topology and falls back to per-kernel dispatch for singletons).
+* **Per-member RNG streams.**  Each member draws its own epoch
+  permutations and block orders from its own seed
+  (:func:`member_plan`), so member ``i`` of a fleet run follows the
+  SAME sample trajectory as a standalone run of that member with the
+  same seed — this is what makes the parity claim testable.
+* **Scan-ordered bank reuse.**  The per-member epoch is the exact
+  bank-mode structure of ``batch.make_multi_epoch_bank_fn`` (device
+  bank permute once per refresh group, per-epoch block order, no
+  per-step gather); the fleet function is its vmap over the member
+  axis.  The math core is ``dp.train_step_math`` — pure jnp, so it
+  vmaps cleanly on every backend (the Pallas step kernels do not
+  vmap; they are the single-kernel TPU path).
+* **Parity mode.**  With ``HPNN_LEDGER`` (or probes/numerics) active,
+  :func:`train_fleet` and :func:`train_sequential` both write one
+  ``ledger.round`` row per member, in member order, through
+  ``obs.probes.check_weights``.  Rows pair positionally in
+  ``tools/ledger_diff.py``, so `fleet vs per-kernel loop` parity is
+  proved under the reference tolerances (1e-14 vectors / 1e-12
+  matrices) — the same bar the cross-rank sentinel uses.
+
+Observability: ``fleet.size`` gauge, ``fleet.round`` /
+``fleet.sequential`` events, ``train.fleet_round`` vs
+``train.member_round`` spans (the name distinguishes fleet from
+singleton dispatch), and ``compile.cost`` / ``perf.*`` gauges for the
+``fleet.multi_epoch`` executable.  Catalog: docs/fleet.md.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from hpnn_tpu import obs
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.parallel import dp
+
+__all__ = [
+    "stack_kernels",
+    "unstack_kernels",
+    "member_plan",
+    "fleet_plan",
+    "make_fleet_epoch_fn",
+    "make_member_epoch_fn",
+    "train_fleet",
+    "train_sequential",
+]
+
+
+# ------------------------------------------------------------------ stacking
+def _check_same_topology(kernels):
+    if not kernels:
+        raise ValueError("fleet needs at least one kernel")
+    ref = kernels[0]
+    ref_shapes = tuple(w.shape for w in ref.weights)
+    ref_dtype = ref.weights[0].dtype
+    for i, k in enumerate(kernels):
+        shapes = tuple(w.shape for w in k.weights)
+        if shapes != ref_shapes or k.weights[0].dtype != ref_dtype:
+            raise ValueError(
+                f"fleet member {i} topology {shapes}/{k.weights[0].dtype} "
+                f"!= member 0 {ref_shapes}/{ref_dtype}; same-topology "
+                "kernels only (the serve layer groups mixed populations)")
+
+
+def stack_kernels(kernels) -> tuple:
+    """Stack N same-topology kernels' weights along a new leading
+    member axis: ``stacked[l].shape == (N,) + weights[l].shape``.
+    Validates topology/dtype agreement first."""
+    import jax.numpy as jnp
+
+    _check_same_topology(kernels)
+    n_layers = len(kernels[0].weights)
+    return tuple(
+        jnp.stack([jnp.asarray(k.weights[l]) for k in kernels])
+        for l in range(n_layers))
+
+
+def unstack_kernels(stacked) -> list:
+    """Inverse of :func:`stack_kernels`: split the member axis back
+    into a list of :class:`Kernel` (host numpy weights)."""
+    mats = [np.asarray(w) for w in stacked]
+    n = mats[0].shape[0]
+    return [kernel_mod.Kernel(weights=tuple(m[i] for m in mats))
+            for i in range(n)]
+
+
+# ------------------------------------------------------------------ planning
+def member_plan(seed: int, *, n_rows: int, batch: int, epochs: int,
+                refresh: int = 8):
+    """One member's private RNG stream → (perms, orders) index plan
+    for the scan-ordered bank (``batch.make_multi_epoch_bank_fn``
+    layout): perms ``(G, n_rows)`` int32 bank permutations (one per
+    refresh group) and orders ``(G, R, S)`` int32 per-epoch block
+    orders, with ``G·R == epochs`` and ``S == n_rows // batch``.
+    When ``refresh`` does not divide ``epochs`` it degrades to
+    refresh=1 (a fresh permutation every epoch)."""
+    if n_rows % batch:
+        raise ValueError(f"batch {batch} must divide n_rows {n_rows}")
+    n_steps = n_rows // batch
+    if epochs % refresh:
+        refresh = 1
+    groups = epochs // refresh
+    rng = np.random.RandomState(seed)
+    perms = np.stack([rng.permutation(n_rows) for _ in range(groups)])
+    orders = np.stack([
+        np.stack([rng.permutation(n_steps) for _ in range(refresh)])
+        for _ in range(groups)])
+    return perms.astype(np.int32), orders.astype(np.int32)
+
+
+def fleet_plan(seeds, *, n_rows: int, batch: int, epochs: int,
+               refresh: int = 8):
+    """Stack :func:`member_plan` over members: perms ``(N, G,
+    n_rows)``, orders ``(N, G, R, S)`` — the fleet function's index
+    inputs, one independent stream per member."""
+    plans = [member_plan(int(s), n_rows=n_rows, batch=batch,
+                         epochs=epochs, refresh=refresh) for s in seeds]
+    return (np.stack([p for p, _ in plans]),
+            np.stack([o for _, o in plans]))
+
+
+# ------------------------------------------------------------------ epoch fns
+def _make_bank_run(n_steps: int, *, model: str, momentum: bool,
+                   lr: float, alpha: float, count: bool):
+    """The single-member multi-epoch bank run (un-jitted) — the exact
+    ``banked=False`` structure of ``batch.make_multi_epoch_bank_fn``
+    with the pure-jnp ``dp.train_step_math`` step, so it is safe to
+    vmap over the member axis."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from hpnn_tpu.train import batch as batch_mod
+
+    count_fn = (batch_mod.make_device_count_fn(model=model) if count
+                else (lambda w, X, T: jnp.int32(0)))
+
+    def run(weights, dw, X, T, perms, orders):
+        def group(carry, pe):
+            w, m = carry
+            perm_g, ord_g = pe
+            Xs = X[perm_g].reshape(n_steps, -1, X.shape[1])
+            Ts = T[perm_g].reshape(n_steps, -1, T.shape[1])
+
+            def epoch(c, ord_e):
+                w2, m2 = c
+
+                def body(cc, k):
+                    w3, m3 = cc
+                    w3, m3, l = dp.train_step_math(
+                        w3, m3, Xs[k], Ts[k], model=model,
+                        momentum=momentum, lr=lr, alpha=alpha)
+                    return (w3, m3), l
+
+                (w2, m2), losses = lax.scan(body, (w2, m2), ord_e)
+                return (w2, m2), (losses, count_fn(w2, X, T))
+
+            (w, m), (losses, counts) = lax.scan(epoch, (w, m), ord_g)
+            return (w, m), (losses, counts)
+
+        (weights, dw), (losses, counts) = lax.scan(
+            group, (weights, dw), (perms, orders))
+        n_epochs = losses.shape[0] * losses.shape[1]
+        return (weights, dw,
+                losses.reshape(n_epochs, -1), counts.reshape(n_epochs))
+
+    return run
+
+
+def make_member_epoch_fn(n_steps: int, *, model: str = "ann",
+                         momentum: bool = False, lr: float | None = None,
+                         alpha: float = 0.2, count: bool = True):
+    """Jitted single-member run — the per-kernel loop baseline.
+    ``run(weights, dw, X, T, perms[G, n_rows], orders[G, R, S]) ->
+    (weights, dw, losses[G·R, S], counts[G·R])``."""
+    import jax
+
+    lr = dp.default_lr(model, momentum) if lr is None else float(lr)
+    return jax.jit(_make_bank_run(n_steps, model=model,
+                                  momentum=momentum, lr=lr, alpha=alpha,
+                                  count=count))
+
+
+def make_fleet_epoch_fn(n_steps: int, *, model: str = "ann",
+                        momentum: bool = False, lr: float | None = None,
+                        alpha: float = 0.2, count: bool = True):
+    """Jitted fleet run — the member run vmapped over the leading
+    member axis of (weights, dw, perms, orders); X/T are shared
+    (each member reads its own permutation of the same bank).
+    ``run(stacked_w, stacked_dw, X, T, perms[N, G, n_rows],
+    orders[N, G, R, S]) -> (stacked_w, stacked_dw, losses[N, G·R, S],
+    counts[N, G·R])`` — one compiled program, one dispatch for the
+    whole fleet."""
+    import jax
+
+    lr = dp.default_lr(model, momentum) if lr is None else float(lr)
+    run = _make_bank_run(n_steps, model=model, momentum=momentum,
+                         lr=lr, alpha=alpha, count=count)
+    return jax.jit(jax.vmap(run, in_axes=(0, 0, None, None, 0, 0)))
+
+
+# ------------------------------------------------------------------ training
+def _zeros_dw(stacked_or_weights, momentum: bool):
+    import jax.numpy as jnp
+
+    if not momentum:
+        return ()
+    return tuple(jnp.zeros_like(w) for w in stacked_or_weights)
+
+
+def _record_member_rows(weight_tuples, *, step, where):
+    """Parity hook: one numerics check (→ one ``ledger.round`` row)
+    per member, in member order, so a fleet ledger and a sequential
+    ledger pair row-for-row in ``tools/ledger_diff.py``.  Inactive
+    (zero work) unless a numerics knob is set."""
+    from hpnn_tpu.obs import probes
+
+    for ws in weight_tuples:
+        probes.check_weights(ws, step=step, where=where)
+
+
+def train_fleet(kernels, X, T, *, epochs: int, batch: int, seeds=None,
+                model: str = "ann", momentum: bool = False,
+                lr: float | None = None, alpha: float = 0.2,
+                refresh: int = 8, count: bool = True):
+    """Train the whole fleet in one dispatch.
+
+    Returns ``(kernels_out, losses[N, epochs, S], counts[N, epochs])``
+    where member ``i`` trained on its own RNG stream ``seeds[i]``
+    (default ``0..N-1``).  Emits ``fleet.size`` / ``fleet.round`` and
+    a ``train.fleet_round`` span; under ``HPNN_COST`` the
+    ``fleet.multi_epoch`` executable is cataloged and its dispatch
+    feeds the ``perf.mfu`` family; under a numerics knob each member
+    gets a parity ledger row (see :func:`train_sequential`)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = len(kernels)
+    seeds = list(range(n)) if seeds is None else list(seeds)
+    if len(seeds) != n:
+        raise ValueError(f"{len(seeds)} seeds for {n} members")
+    stacked = stack_kernels(kernels)
+    dw = _zeros_dw(stacked, momentum)
+    X = jnp.asarray(X)
+    T = jnp.asarray(T)
+    perms, orders = fleet_plan(seeds, n_rows=X.shape[0], batch=batch,
+                               epochs=epochs, refresh=refresh)
+    n_steps = X.shape[0] // batch
+    fn = make_fleet_epoch_fn(n_steps, model=model, momentum=momentum,
+                             lr=lr, alpha=alpha, count=count)
+    if obs.cost.enabled():
+        obs.cost.analyze_fn("fleet.multi_epoch", fn, stacked, dw, X, T,
+                            perms, orders, units=n * epochs * n_steps,
+                            members=n, mode="fleet")
+    obs.gauge("fleet.size", n, where="train")
+    with obs.spans.span("train.fleet_round", members=n, epochs=epochs,
+                        mode="fleet"):
+        t0 = time.perf_counter()
+        stacked, dw, losses, counts = fn(stacked, dw, X, T, perms,
+                                         orders)
+        jax.block_until_ready(stacked)
+        dt = time.perf_counter() - t0
+    if obs.cost.enabled():
+        obs.cost.record_dispatch("fleet.multi_epoch", dt,
+                                 units=n * epochs * n_steps)
+    obs.event("fleet.round", members=n, epochs=epochs, batch=batch,
+              steps=n_steps, mode="fleet", dispatch_s=round(dt, 6))
+    out = unstack_kernels(stacked)
+    _record_member_rows([k.weights for k in out], step=epochs,
+                        where="fleet_round")
+    return out, np.asarray(losses), np.asarray(counts)
+
+
+def train_sequential(kernels, X, T, *, epochs: int, batch: int,
+                     seeds=None, model: str = "ann",
+                     momentum: bool = False, lr: float | None = None,
+                     alpha: float = 0.2, refresh: int = 8,
+                     count: bool = True):
+    """The per-kernel loop baseline: identical math, identical
+    per-member RNG streams, but one dispatch per member.  Writes the
+    same parity ledger rows (same member order, same ``where``), so
+    ``ledger_diff`` of a fleet run vs this loop proves per-member
+    agreement within the reference tolerances."""
+    import jax
+    import jax.numpy as jnp
+
+    n = len(kernels)
+    seeds = list(range(n)) if seeds is None else list(seeds)
+    if len(seeds) != n:
+        raise ValueError(f"{len(seeds)} seeds for {n} members")
+    _check_same_topology(kernels)
+    X = jnp.asarray(X)
+    T = jnp.asarray(T)
+    n_steps = X.shape[0] // batch
+    fn = make_member_epoch_fn(n_steps, model=model, momentum=momentum,
+                              lr=lr, alpha=alpha, count=count)
+    obs.gauge("fleet.size", n, where="train_sequential")
+    out, all_losses, all_counts = [], [], []
+    t0 = time.perf_counter()
+    for i, (k, seed) in enumerate(zip(kernels, seeds)):
+        perms, orders = member_plan(int(seed), n_rows=X.shape[0],
+                                    batch=batch, epochs=epochs,
+                                    refresh=refresh)
+        w = tuple(jnp.asarray(wl) for wl in k.weights)
+        dw = _zeros_dw(w, momentum)
+        with obs.spans.span("train.member_round", member=i,
+                            epochs=epochs, mode="sequential"):
+            w, dw, losses, counts = fn(w, dw, X, T, perms, orders)
+            jax.block_until_ready(w)
+        out.append(kernel_mod.Kernel(
+            weights=tuple(np.asarray(wl) for wl in w)))
+        all_losses.append(np.asarray(losses))
+        all_counts.append(np.asarray(counts))
+    dt = time.perf_counter() - t0
+    obs.event("fleet.sequential", members=n, epochs=epochs, batch=batch,
+              steps=n_steps, mode="sequential", dispatch_s=round(dt, 6))
+    _record_member_rows([k.weights for k in out], step=epochs,
+                        where="fleet_round")
+    return out, np.stack(all_losses), np.stack(all_counts)

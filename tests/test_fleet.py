@@ -1,0 +1,373 @@
+"""Fleet batching (hpnn_tpu/train/fleet.py + serve fleet dispatch,
+docs/fleet.md).
+
+Acceptance bar (ISSUE 6): a same-seed 8-member fleet trained in ONE
+vmapped dispatch produces ledgers that ``tools/ledger_diff.py``
+reports clean against 8 sequential per-kernel runs (reference
+1e-14/1e-12 tolerances — on the f64 CPU path the weights are in fact
+bitwise equal), and serve-side fleet dispatch in parity mode returns
+outputs bitwise equal to the per-kernel ``engine.dispatch`` path.
+Also covers: the double-buffered banked epoch's interpret-mode parity
+with the grid epoch, topology validation/fallback rules, the
+pad-waste / fleet.* obs emissions, the Session fleet mode round trip,
+and the ``--perf`` lint's fleet record rules.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import ann, kernel as kernel_mod
+from hpnn_tpu.serve.engine import Engine, fleet_key
+from hpnn_tpu.serve.registry import Registry
+from hpnn_tpu.train import fleet
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _kernels(n, seed0=7, n_in=8, hiddens=(5,), n_out=2):
+    return [kernel_mod.generate(seed0 + i, n_in, list(hiddens), n_out)[0]
+            for i in range(n)]
+
+
+def _data(n_rows=8, n_in=8, n_out=2, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, (n_rows, n_in))
+    T = np.full((n_rows, n_out), -1.0)
+    T[np.arange(n_rows), rng.randint(0, n_out, n_rows)] = 1.0
+    return X, T
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+# ---------------------------------------------------------- stacking
+def test_stack_unstack_roundtrip_and_topology_validation():
+    ks = _kernels(3)
+    stacked = fleet.stack_kernels(ks)
+    assert stacked[0].shape == (3, 5, 8) and stacked[1].shape == (3, 2, 5)
+    back = fleet.unstack_kernels(stacked)
+    for a, b in zip(ks, back):
+        for wa, wb in zip(a.weights, b.weights):
+            assert np.array_equal(np.asarray(wa), np.asarray(wb))
+    odd = kernel_mod.generate(1, 8, [6], 2)[0]  # different hidden width
+    with pytest.raises(ValueError, match="topology"):
+        fleet.stack_kernels(ks + [odd])
+    with pytest.raises(ValueError, match="at least one"):
+        fleet.stack_kernels([])
+
+
+def test_member_plan_shapes_and_refresh_degrade():
+    perms, orders = fleet.member_plan(5, n_rows=8, batch=2, epochs=16,
+                                      refresh=8)
+    assert perms.shape == (2, 8) and orders.shape == (2, 8, 4)
+    # refresh that does not divide epochs degrades to 1 (fresh
+    # permutation every epoch), never silently truncates
+    perms, orders = fleet.member_plan(5, n_rows=8, batch=2, epochs=3,
+                                      refresh=8)
+    assert perms.shape == (3, 8) and orders.shape == (3, 1, 4)
+    # per-member streams differ, same seed reproduces
+    p2, _ = fleet.member_plan(6, n_rows=8, batch=2, epochs=3)
+    p1, _ = fleet.member_plan(5, n_rows=8, batch=2, epochs=3)
+    assert not np.array_equal(p1, p2)
+    assert np.array_equal(p1, fleet.member_plan(5, n_rows=8, batch=2,
+                                                epochs=3)[0])
+
+
+# ---------------------------------------------- fleet vs sequential
+def test_fleet_vs_sequential_bitwise_and_ledger_diff_clean(
+        tmp_path, monkeypatch):
+    """AC: same-seed 8-member fleet vs 8 sequential runs — weights
+    bitwise equal on the f64 CPU path, and the two parity ledgers
+    diff clean under the reference tolerances."""
+    ks = _kernels(8)
+    X, T = _data()
+    seeds = list(range(8))
+    led_f = tmp_path / "fleet.jsonl"
+    led_s = tmp_path / "seq.jsonl"
+
+    monkeypatch.setenv("HPNN_LEDGER", str(led_f))
+    obs._reset_for_tests()
+    out_f, loss_f, cnt_f = fleet.train_fleet(
+        ks, X, T, epochs=2, batch=2, seeds=seeds)
+
+    monkeypatch.setenv("HPNN_LEDGER", str(led_s))
+    obs._reset_for_tests()
+    out_s, loss_s, cnt_s = fleet.train_sequential(
+        ks, X, T, epochs=2, batch=2, seeds=seeds)
+
+    monkeypatch.delenv("HPNN_LEDGER", raising=False)
+    obs._reset_for_tests()  # close the ledger files
+
+    assert loss_f.shape == (8, 2, 4) and cnt_f.shape == (8, 2)
+    for kf, ks_ in zip(out_f, out_s):
+        for wa, wb in zip(kf.weights, ks_.weights):
+            assert np.array_equal(np.asarray(wa), np.asarray(wb))
+    assert np.array_equal(loss_f, loss_s)
+    assert np.array_equal(cnt_f, cnt_s)
+
+    ld = _load_tool("ledger_diff")
+    rows_f = ld.load_rounds(str(led_f))
+    rows_s = ld.load_rounds(str(led_s))
+    assert len(rows_f) == 8 and len(rows_s) == 8  # one row per member
+    assert {r["where"] for r in rows_f} == {"fleet_round"}
+    report = ld.compare(rows_f, rows_s)
+    assert report["clean"], report["divergent"]
+    assert ld.main([str(led_f), str(led_s)]) == 0
+    # the fleet ledger also passes the frozen-schema lint
+    cat = _load_tool("check_obs_catalog")
+    assert cat.lint_ledger(str(led_f)) == []
+
+
+def test_train_fleet_validates_seed_count():
+    ks = _kernels(2)
+    X, T = _data()
+    with pytest.raises(ValueError, match="seeds"):
+        fleet.train_fleet(ks, X, T, epochs=1, batch=2, seeds=[1])
+
+
+# ------------------------------------------- double-buffered epoch
+@pytest.mark.parametrize("momentum", [False, True])
+def test_dbuf_epoch_matches_grid_epoch_interpret(momentum):
+    """The explicit DMA pipeline computes the exact same epoch as the
+    grid kernel (interpret mode; bitwise f32)."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.ops import pallas_train
+
+    k = _kernels(1)[0]
+    w = tuple(jnp.asarray(np.asarray(wl), jnp.float32) for wl in k.weights)
+    dw = tuple(jnp.zeros_like(wl) for wl in w) if momentum else ()
+    X, T = _data(n_rows=12)
+    Xb = jnp.asarray(X, jnp.float32)
+    Tb = jnp.asarray(T, jnp.float32)
+    order = jnp.asarray(np.random.RandomState(0).permutation(3),
+                        jnp.int32)  # S=3 blocks of B=4
+    wg, dwg, lg = pallas_train.train_epoch_grid_banked(
+        w, dw, Xb, Tb, order, batch=4, momentum=momentum,
+        interpret=True)
+    wd, dwd, ldb = pallas_train.train_epoch_dbuf_banked(
+        w, dw, Xb, Tb, order, batch=4, momentum=momentum,
+        interpret=True)
+    for a, b in zip(wg, wd):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(dwg, dwd):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(np.asarray(lg), np.asarray(ldb))
+
+
+def test_bank_fn_dbuf_convention_matches_per_step_path():
+    """make_multi_epoch_bank_fn(banked="dbuf") hands the WHOLE epoch
+    to the step fn (the grid/dbuf call convention); with a pure-jnp
+    epoch body it must reproduce the banked=False per-step trajectory
+    bitwise."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from hpnn_tpu.parallel import dp
+    from hpnn_tpu.train import batch as batch_mod
+
+    k = _kernels(1)[0]
+    w = tuple(jnp.asarray(np.asarray(wl)) for wl in k.weights)
+    X, T = _data(n_rows=8)
+    X, T = jnp.asarray(X), jnp.asarray(T)
+    S, lr = 4, dp.default_lr("ann", False)
+
+    def math_step(w2, m2, Xb, Tb):
+        return dp.train_step_math(w2, m2, Xb, Tb, model="ann",
+                                  momentum=False, lr=lr, alpha=0.2)
+
+    def epoch_fn(w2, m2, Xp, Tp, ord_e):
+        Xs = Xp.reshape(S, -1, Xp.shape[1])
+        Ts = Tp.reshape(S, -1, Tp.shape[1])
+
+        def body(c, kk):
+            w3, m3 = c
+            w3, m3, l = math_step(w3, m3, Xs[kk], Ts[kk])
+            return (w3, m3), l
+
+        (w2, m2), losses = lax.scan(body, (w2, m2), ord_e)
+        return w2, m2, losses
+
+    count_fn = batch_mod.make_device_count_fn(model="ann")
+    fn_dbuf = batch_mod.make_multi_epoch_bank_fn(
+        epoch_fn, count_fn, S, banked="dbuf")
+    fn_base = batch_mod.make_multi_epoch_bank_fn(
+        math_step, count_fn, S, banked=False)
+    perms, orders = fleet.member_plan(3, n_rows=8, batch=2, epochs=2)
+    wa, _, la, ca = fn_dbuf(w, (), X, T, perms, orders)
+    wb, _, lb, cb = fn_base(w, (), X, T, perms, orders)
+    for a, b in zip(wa, wb):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(np.asarray(la), np.asarray(lb))
+    assert np.array_equal(np.asarray(ca), np.asarray(cb))
+
+
+# ------------------------------------------------------ serve fleet
+def _engine(names_kernels, **kw):
+    reg = Registry()
+    for name, k in names_kernels:
+        reg.register(name, k)
+    return Engine(reg, max_batch=8, n_buckets=2, **kw)
+
+
+def test_fleet_key_groups_by_topology():
+    a, b = _kernels(2)
+    odd = kernel_mod.generate(1, 8, [6], 2)[0]
+    reg = Registry()
+    reg.register("a", a)
+    reg.register("b", b)
+    reg.register("odd", odd)
+    assert fleet_key(reg.get("a")) == fleet_key(reg.get("b"))
+    assert fleet_key(reg.get("a")) != fleet_key(reg.get("odd"))
+
+
+def test_dispatch_fleet_parity_matches_per_kernel_dispatch():
+    """AC: fleet dispatch in parity mode is bitwise identical to the
+    per-kernel engine.dispatch path, with results in payload order."""
+    a, b = _kernels(2)
+    eng = _engine([("a", a), ("b", b)], mode="parity")
+    rng = np.random.RandomState(1)
+    pa1, pb, pa2 = (rng.uniform(-1, 1, (2, 8)), rng.uniform(-1, 1, (3, 8)),
+                    rng.uniform(-1, 1, (1, 8)))
+    results = eng.dispatch_fleet([("a", pa1), ("b", pb), ("a", pa2)])
+    assert [r.shape for r in results] == [(2, 2), (3, 2), (1, 2)]
+    ref_a = eng.dispatch("a", [pa1, pa2])
+    ref_b = eng.dispatch("b", [pb])
+    assert np.array_equal(results[0], ref_a[0])
+    assert np.array_equal(results[2], ref_a[1])
+    assert np.array_equal(results[1], ref_b[0])
+    # and both equal the direct per-sample reference forward
+    direct = np.stack([np.asarray(ann.run(a.weights, x)) for x in pa1])
+    assert np.array_equal(results[0], direct)
+
+
+def test_dispatch_fleet_fallbacks():
+    """Singleton groups, mixed topologies, and oversize batches take
+    the per-kernel path — same answers, no fleet executable."""
+    a, b = _kernels(2)
+    odd = kernel_mod.generate(1, 8, [6], 2)[0]
+    eng = _engine([("a", a), ("b", b), ("odd", odd)], mode="parity")
+    rng = np.random.RandomState(2)
+    ra = rng.uniform(-1, 1, (2, 8))
+    rodd = rng.uniform(-1, 1, (2, 8))
+    # mixed topology: "odd" can never join a's group
+    res = eng.dispatch_fleet([("a", ra), ("odd", rodd)])
+    assert np.array_equal(res[0], eng.dispatch("a", [ra])[0])
+    assert np.array_equal(res[1], eng.dispatch("odd", [rodd])[0])
+    # oversize: rows above the top bucket chunk via the per-kernel path
+    big = rng.uniform(-1, 1, (11, 8))  # top bucket is 8
+    rb = rng.uniform(-1, 1, (2, 8))
+    res = eng.dispatch_fleet([("a", big), ("b", rb)])
+    assert np.array_equal(res[0], eng.dispatch("a", [big])[0])
+    assert np.array_equal(res[1], eng.dispatch("b", [rb])[0])
+
+
+def test_dispatch_fleet_compiled_mode_close_to_parity():
+    a, b = _kernels(2)
+    par = _engine([("a", a), ("b", b)], mode="parity")
+    comp = _engine([("a", a), ("b", b)], mode="compiled")
+    rng = np.random.RandomState(4)
+    pa = rng.uniform(-1, 1, (3, 8))
+    pb = rng.uniform(-1, 1, (2, 8))
+    rp = par.dispatch_fleet([("a", pa), ("b", pb)])
+    rc = comp.dispatch_fleet([("a", pa), ("b", pb)])
+    for x, y in zip(rp, rc):
+        np.testing.assert_allclose(x, y, atol=1e-12, rtol=0)
+
+
+def test_fleet_obs_emissions(tmp_path, monkeypatch):
+    """One coalesced fleet group emits serve.fleet_group, the
+    fleet.size gauge (where=serve), the serve.fleet_dispatch span,
+    and a per-member serve.pad_waste gauge tagged fleet=True."""
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    obs._reset_for_tests()
+    a, b = _kernels(2)
+    eng = _engine([("a", a), ("b", b)], mode="parity")
+    rng = np.random.RandomState(6)
+    eng.dispatch_fleet([("a", rng.uniform(-1, 1, (2, 8))),
+                        ("b", rng.uniform(-1, 1, (3, 8)))])
+    monkeypatch.delenv("HPNN_SPANS", raising=False)
+    obs._reset_for_tests()
+    recs = _read(sink)
+    by = {}
+    for r in recs:
+        by.setdefault(r["ev"], []).append(r)
+    grp = by["serve.fleet_group"][0]
+    assert grp["members"] == 2 and grp["rows"] == 5
+    sizes = [r for r in by["fleet.size"] if r.get("where") == "serve"]
+    assert sizes and sizes[0]["value"] == 2
+    waste = [r for r in by["serve.pad_waste"] if r.get("fleet")]
+    assert {r["kernel"] for r in waste} == {"a", "b"}
+    assert all(r["value"] == 0.0 for r in waste)  # parity never pads
+    spans = [r for r in by["span.end"]
+             if r.get("name") == "serve.fleet_dispatch"]
+    assert spans and spans[0]["members"] == 2
+    # the sink also passes the --perf fleet rules
+    cat = _load_tool("check_obs_catalog")
+    assert cat.lint_perf(str(sink)) == []
+
+
+def test_session_fleet_mode_roundtrip():
+    """End to end: Session(fleet=True) serves two same-topology
+    kernels through ONE shared batcher, answers bitwise-equal to the
+    direct forward (CPU parity mode)."""
+    a, b = _kernels(2)
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0,
+                         fleet=True)
+    try:
+        sess.register_kernel("a", a)
+        sess.register_kernel("b", b)
+        x = np.random.RandomState(9).uniform(-1, 1, 8)
+        ya = sess.infer("a", x)
+        yb = sess.infer("b", x)
+        assert np.array_equal(ya, np.asarray(ann.run(a.weights, x)))
+        assert np.array_equal(yb, np.asarray(ann.run(b.weights, x)))
+        # one shared batcher, named for the fleet
+        assert sess.batcher_for("a") is sess.batcher_for("b")
+        assert list(sess.health()["batchers"]) == ["(fleet)"]
+    finally:
+        sess.close()
+
+
+# -------------------------------------------------- --perf fleet lint
+def test_lint_perf_fleet_rules(tmp_path):
+    cat = _load_tool("check_obs_catalog")
+    bad = tmp_path / "bad.jsonl"
+    rows = [
+        {"ts": 1.0, "ev": "span.end", "kind": "event", "span": 1,
+         "parent": None, "name": "serve.fleet_dispatch", "t0": 0.0,
+         "dt": 0.1},                                  # no members
+        {"ts": 1.0, "ev": "fleet.size", "kind": "gauge", "value": 0,
+         "where": "serve"},                           # empty fleet
+    ]
+    bad.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    fails = cat.lint_perf(str(bad))
+    assert any("members" in f for f in fails)
+    assert any("fleet.size" in f for f in fails)
+    good = tmp_path / "good.jsonl"
+    rows = [
+        {"ts": 1.0, "ev": "span.end", "kind": "event", "span": 1,
+         "parent": None, "name": "serve.fleet_dispatch", "t0": 0.0,
+         "dt": 0.1, "members": 2, "bucket": 8},
+        {"ts": 1.0, "ev": "fleet.size", "kind": "gauge", "value": 2,
+         "where": "serve"},
+    ]
+    good.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert cat.lint_perf(str(good)) == []

@@ -10,6 +10,10 @@ Variants (all through the production step implementations):
   (make_multi_epoch_bank_fn).  Arithmetically the permute (full-bank
   read+write once per epoch) costs exactly what the per-step gather
   did, so this can only win on per-step op overhead.
+* ``bankRdbuf-pallas`` — the refresh-group bank epoch with the
+  double-buffered HBM→VMEM DMA pipeline kernel
+  (train_epoch_dbuf_banked); reported against ``bankR-pallas`` as a
+  paired per-repeat delta (``paired_dbuf_vs_grid_pct``).
 * ``order-xla`` / ``order-pallas`` — shuffle-once bank + per-epoch
   random block ORDER: zero per-epoch data movement; the Pallas banked
   kernel block-fetches straight from HBM (the only true traffic
@@ -67,6 +71,11 @@ def make_variants(*, n_in, n_hidden, n_out, B, S, momentum, model="ann"):
 
     def grid_epoch(w, m, Xp, Tp, ord_e):
         return pallas_train.train_epoch_grid_banked(
+            w, m, Xp, Tp, ord_e, batch=B, model=model, momentum=momentum,
+            lr=lr, alpha=0.2)
+
+    def dbuf_epoch(w, m, Xp, Tp, ord_e):
+        return pallas_train.train_epoch_dbuf_banked(
             w, m, Xp, Tp, ord_e, batch=B, model=model, momentum=momentum,
             lr=lr, alpha=0.2)
 
@@ -145,6 +154,11 @@ def make_variants(*, n_in, n_hidden, n_out, B, S, momentum, model="ann"):
             math_step, count_fn, S, banked=False),
         "bankR-pallas": batch_mod.make_multi_epoch_bank_fn(
             grid_epoch, count_fn, S, banked="grid"),
+        # same bank/refresh schedule, but the epoch kernel streams its
+        # blocks through a double-buffered HBM->VMEM DMA pipeline
+        # (train_epoch_dbuf_banked) instead of grid BlockSpec fetches
+        "bankRdbuf-pallas": batch_mod.make_multi_epoch_bank_fn(
+            dbuf_epoch, count_fn, S, banked="dbuf"),
         "bankRscan-pallas": batch_mod.make_multi_epoch_bank_fn(
             banked_step, count_fn, S, banked=True),
         "order-xla": make_order_fn(False),
@@ -246,6 +260,17 @@ def run_shape(label, *, n_in, n_hidden, n_out, B, S, momentum,
             ]
             out[name]["paired_gain_median_pct"] = round(
                 deltas[len(deltas) // 2], 1)
+    # the double-buffered vs single-buffered banked epoch, as a paired
+    # per-repeat delta (same discipline as the gather-pallas baseline):
+    # positive % = the DMA pipeline is faster than grid BlockSpec fetch
+    sbuf, dbuf = slopes.get("bankR-pallas"), slopes.get("bankRdbuf-pallas")
+    if sbuf and dbuf:
+        deltas = sorted((b - a) / b * 100.0 for a, b in zip(dbuf, sbuf))
+        out["bankRdbuf-pallas"]["paired_dbuf_vs_grid_pct"] = [
+            round(d, 1) for d in deltas
+        ]
+        out["bankRdbuf-pallas"]["paired_dbuf_vs_grid_median_pct"] = round(
+            deltas[len(deltas) // 2], 1)
     print(json.dumps({"shape": label, "B": B, "steps_per_epoch": S,
                       "results": out}, indent=1), flush=True)
     return out

@@ -46,6 +46,8 @@ GATE_METRICS = {
     "batch_sps_median": ("higher", 0.30),
     "per_sample_dispatch_sps": ("higher", 0.30),
     "serve_rps": ("higher", 0.40),
+    "fleet_agg_sps": ("higher", 0.40),
+    "fleet_speedup_x": ("higher", 0.30),
     "slope_us_per_step": ("lower", 0.50),
     "prod_us_per_step": ("lower", 0.50),
     "serve_p50_ms": ("lower", 0.60),

@@ -1,8 +1,9 @@
 #!/usr/bin/env python3
 """Event-catalog drift lint for the obs subsystem.
 
-docs/observability.md (plus the serving catalog in docs/serving.md)
-promises a complete event-name catalog.  That promise rots silently:
+docs/observability.md (plus the serving catalog in docs/serving.md
+and the fleet catalog in docs/fleet.md) promises a complete
+event-name catalog.  That promise rots silently:
 an instrumented site added without a docs row leaves operators grepping
 a name the docs never mention.  This lint closes the loop — it greps
 every ``obs.event/count/gauge/observe/timer`` call site (and the raw
@@ -61,7 +62,8 @@ DOC_RE = re.compile(
     r"`([a-z0-9_]+(?:\.(?:[a-z0-9_]+|\*))+)`"
 )
 
-DOC_PAGES = ("docs/observability.md", "docs/serving.md")
+DOC_PAGES = ("docs/observability.md", "docs/serving.md",
+             "docs/fleet.md")
 SRC_DIR = "hpnn_tpu"
 
 
@@ -242,6 +244,11 @@ def lint_perf(path: str) -> list[str]:
       present and not an error record; ``units`` a positive int.
     * ``perf.*`` gauges — ``kind == "gauge"``, finite non-negative
       ``value``, and an ``exe`` field attributing the rate.
+    * ``fleet.*`` records — gauges (``fleet.size``) carry a finite
+      ``value`` ≥ 1 (an empty fleet is a grouping bug); and every
+      fleet-named span (``name`` containing ``fleet``) carries a
+      ``members`` count ≥ 1, so dashboards can always attribute a
+      fleet dispatch to its width (docs/fleet.md).
 
     Other records pass through untouched — the sink interleaves every
     obs family.  Returns failure strings (empty = pass).
@@ -297,6 +304,15 @@ def lint_perf(path: str) -> list[str]:
                 continue
             spans[sid] = rec
             span_recs.append((at, rec))
+            # fleet-named spans must say how wide the fleet was
+            name = rec.get("name")
+            if isinstance(name, str) and "fleet" in name:
+                members = rec.get("members")
+                if not isinstance(members, int) \
+                        or isinstance(members, bool) or members < 1:
+                    failures.append(
+                        f"{at}: fleet span {name!r} members "
+                        f"{members!r} is not an int >= 1")
         elif ev == "compile.cost":
             missing = COST_REQUIRED - set(rec)
             if missing:
@@ -338,6 +354,13 @@ def lint_perf(path: str) -> list[str]:
                 failures.append(
                     f"{at}: {ev} has no exe field — the rate is "
                     "unattributable")
+        elif isinstance(ev, str) and ev.startswith("fleet.") \
+                and rec.get("kind") == "gauge":
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 1:
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a finite number "
+                    ">= 1 (an empty fleet is a grouping bug)")
     # nesting: a child whose parent finished in this file must sit
     # inside the parent's interval (both clocks are the same
     # time.perf_counter, so the comparison is meaningful)

@@ -185,22 +185,48 @@ def check(tmpdir: str) -> list[str]:
     # stack (registry → batcher → bucketed engine) — must leave the
     # next train+eval round's stdout byte-identical.  The session is
     # exercised BEFORE the round so its jit/compile-cache residue is
-    # live while the round prints.
+    # live while the round prints.  The fleet path (docs/fleet.md)
+    # rides the same proof: a fleet-mode session over two
+    # same-topology kernels plus a vmapped train_fleet round, all
+    # with the obs knobs off — neither may add a stdout byte.
     import numpy as np
 
     from hpnn_tpu import serve
     from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.train import fleet as fleet_mod
 
     sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
     k, _ = kernel_mod.generate(7, 8, [5], 2)
     sess.register_kernel("lint", k)
     sess.infer("lint", np.zeros(8))
     sess.close()
+
+    fsess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0,
+                          fleet=True)
+    k2, _ = kernel_mod.generate(11, 8, [5], 2)
+    fsess.register_kernel("lint_a", k)
+    fsess.register_kernel("lint_b", k2)
+    fsess.infer("lint_a", np.zeros(8))
+    fsess.infer("lint_b", np.zeros(8))
+    # force one genuinely coalesced two-member group through the
+    # stacked executable (sequential infers usually drain solo)
+    fsess.engine.dispatch_fleet([("lint_a", np.zeros((1, 8))),
+                                 ("lint_b", np.zeros((1, 8)))])
+    fsess.close()
+
+    rng = np.random.RandomState(5)
+    Xf = rng.uniform(-1, 1, (4, 8)).astype(np.float64)
+    Tf = np.full((4, 2), -1.0)
+    Tf[np.arange(4), rng.randint(0, 2, 4)] = 1.0
+    fleet_mod.train_fleet([k, k2], Xf, Tf, epochs=1, batch=2,
+                          seeds=[1, 2])
+
     with_serve = _run_round(os.path.join(tmpdir, "c"), None)
     if plain != with_serve:
         failures.append(
             "stdout is NOT byte-identical after importing/exercising "
-            f"hpnn_tpu.serve (plain {len(plain)}B vs "
+            "hpnn_tpu.serve (per-kernel + fleet) and "
+            f"train.fleet (plain {len(plain)}B vs "
             f"with-serve {len(with_serve)}B)")
 
     # The zero-perturbation proof for the numerics probes: a run with
