@@ -808,6 +808,21 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["serve_smoke_error"] = repr(exc)
 
+    # Load + SLO (tools/loadgen.py run_bench_load): saturation probe,
+    # then 2x-saturation open-loop against an SLO-armed shedding
+    # server — records goodput vs the plateau and the windowed p99 of
+    # accepted requests (docs/observability.md "SLOs and load").
+    # Best-effort; HPNN_BENCH_NO_LOAD=1 skips it.
+    if not os.environ.get("HPNN_BENCH_NO_LOAD"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import loadgen
+
+            out["load"] = loadgen.run_bench_load()
+        except Exception as exc:
+            out["load_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -864,6 +879,12 @@ def main(argv=None) -> None:
         compact["serve_p50_ms"] = sm["latency_ms"]["p50"]
         compact["serve_p99_ms"] = sm["latency_ms"]["p99"]
         compact["serve_rps"] = sm["throughput_rps"]
+    if "load" in out:
+        ld = out["load"]
+        compact["load_goodput_rps"] = ld["goodput_rps"]
+        compact["load_p99_ms"] = ld["p99_under_load_ms"]
+        compact["load_goodput_vs_saturation"] = (
+            ld["goodput_vs_saturation"])
     if "obs_overhead" in out:
         compact["obs_overhead_pct"] = (
             out["obs_overhead"]["paired_overhead_pct"]["median"]

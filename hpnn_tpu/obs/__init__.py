@@ -31,7 +31,13 @@ structured side channel next to it:
   and compiled-cost introspection (FLOPs/bytes per executable via the
   AOT ``cost_analysis``/``memory_analysis`` surface) feeding
   ``perf.flops_per_s`` / ``perf.mfu`` / ``perf.bytes_per_s`` gauges —
-  ``HPNN_COST`` (obs/cost.py; regression gate: tools/bench_gate.py).
+  ``HPNN_COST`` (obs/cost.py; regression gate: tools/bench_gate.py);
+* SLO observability: a rolling window of serve request outcomes
+  computing windowed p50/p99, attainment against a latency objective,
+  and error-budget burn rate — ``HPNN_SLO_MS`` (obs/slo.py), exported
+  as ``slo.*`` gauges on ``/metrics`` and the ``/healthz`` verdict,
+  and feeding the batcher's SLO-driven load shedding
+  (serve/batcher.py; load harness: tools/loadgen.py).
 
 Typical instrumentation site::
 
@@ -46,7 +52,7 @@ Event-name catalog and schema: docs/observability.md.
 """
 
 from hpnn_tpu.obs import (cost, device, export, flight, ledger, probes,
-                          spans)
+                          slo, spans)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -83,6 +89,7 @@ __all__ = [
     "observe",
     "probes",
     "sink_path",
+    "slo",
     "snapshot_state",
     "spans",
     "step_annotation",

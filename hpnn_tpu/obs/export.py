@@ -18,8 +18,9 @@ Mapping: obs counters become Prometheus ``counter``s (``_total``
 suffix), obs gauges become ``gauge``s, and timer/histogram aggregates
 become ``summary`` metrics — q0.5/q0.9/q0.99 are estimated from the
 registry's log2 buckets (the quantile lands in bucket ``(2^(k-1),
-2^k]``; its upper bound, clamped to the observed min/max, is the
-estimate — conservative and monotone) plus exact ``_sum``/``_count``.
+2^k]``; the estimate interpolates linearly within that bucket's span
+by rank, clamped to the observed min/max — monotone, and within one
+bucket width of exact) plus exact ``_sum``/``_count``.
 Metric names are ``hpnn_`` + the event name with non-alphanumerics
 mapped to ``_`` (``driver.chunk_dispatch`` →
 ``hpnn_driver_chunk_dispatch``).
@@ -113,8 +114,12 @@ def _fmt(v) -> str:
 def _quantile_estimate(agg: dict, q: float) -> float:
     """Estimate quantile ``q`` from a registry aggregate snapshot's
     log2 buckets: walk buckets in order until the cumulative count
-    reaches ``q * n``, answer that bucket's upper bound clamped to the
-    observed [min, max]."""
+    reaches ``q * n``, then interpolate linearly *within* the landing
+    bucket ``k`` (span ``[2^(k-1), 2^k)``) by how far into its count
+    the target falls — answering the upper bound alone overestimates
+    by up to 2x.  The result is clamped to the observed [min, max],
+    which also repairs bucket 0 (it additionally holds values ≤ 0,
+    below its nominal span)."""
     buckets = agg.get("log2_buckets") or {}
     n = agg.get("n") or 0
     vmin, vmax = agg.get("min"), agg.get("max")
@@ -123,14 +128,18 @@ def _quantile_estimate(agg: dict, q: float) -> float:
     target = q * n
     seen = 0
     for k in sorted(buckets, key=int):
-        seen += buckets[k]
+        c = buckets[k]
+        seen += c
         if seen >= target:
-            hi = 0.0 if int(k) <= 0 else 2.0 ** int(k)
+            ki = int(k)
+            lo, hi = 2.0 ** (ki - 1), 2.0 ** ki
+            frac = (target - (seen - c)) / c
+            est = lo + frac * (hi - lo)
             if vmax is not None:
-                hi = min(hi, float(vmax))
+                est = min(est, float(vmax))
             if vmin is not None:
-                hi = max(hi, float(vmin))
-            return hi
+                est = max(est, float(vmin))
+            return est
     return float(vmax) if vmax is not None else 0.0
 
 

@@ -510,7 +510,7 @@ def _reset_for_tests() -> None:
     # (export/ledger/probes all import registry)
     for name in ("hpnn_tpu.obs.export", "hpnn_tpu.obs.ledger",
                  "hpnn_tpu.obs.probes", "hpnn_tpu.obs.cost",
-                 "hpnn_tpu.obs.spans"):
+                 "hpnn_tpu.obs.spans", "hpnn_tpu.obs.slo"):
         mod = sys.modules.get(name)
         if mod is not None:
             mod._reset_for_tests()

@@ -13,7 +13,7 @@ zero steady-state compiles.  Layers, bottom up:
   vmap on throughput backends, the bitwise-exact per-sample path in
   CPU parity mode;
 * :mod:`~hpnn_tpu.serve.batcher` — bounded coalescing queue with
-  deadlines and explicit backpressure;
+  deadlines, explicit backpressure, and SLO-driven load shedding;
 * :mod:`~hpnn_tpu.serve.server` — :class:`Session` (the in-process
   embedding API) and the stdlib HTTP front end.
 
@@ -22,7 +22,7 @@ the first compile, same discipline as ``hpnn_tpu.obs``.  Architecture
 and semantics: docs/serving.md.
 """
 
-from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull
+from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull, Shed
 from hpnn_tpu.serve.engine import Engine, bucket_for, bucket_menu
 from hpnn_tpu.serve.registry import Entry, Registry, RegistryError
 from hpnn_tpu.serve.server import Session, make_server
@@ -31,6 +31,7 @@ __all__ = [
     "Batcher",
     "DeadlineExceeded",
     "QueueFull",
+    "Shed",
     "Engine",
     "bucket_menu",
     "bucket_for",

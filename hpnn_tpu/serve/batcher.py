@@ -13,6 +13,14 @@ Semantics, in order of precedence:
   a submit beyond that raises :class:`QueueFull` immediately
   (retriable — the caller should back off and resubmit, the HTTP
   front end maps it to 429).
+* **SLO-driven shedding** — when the oldest queued request has aged
+  past ``shed_age_ms`` (``HPNN_SHED_AGE_MS``), or the rolling-window
+  p99 of served requests (obs/slo.py, requires ``HPNN_SLO_MS``) is
+  past ``shed_p99_ms`` (``HPNN_SHED_P99_MS``), a submit is rejected
+  up front with :class:`Shed` (a :class:`QueueFull` subclass, so the
+  HTTP 429 + ``Retry-After`` mapping already applies) — saturation
+  then degrades goodput gracefully instead of queueing work that is
+  doomed to blow its deadline.  Either threshold at 0 disables it.
 * **Deadlines** — every request carries an absolute deadline
   (``timeout_s`` from submit time).  The drain loop drops expired
   requests *before* dispatch and completes them with
@@ -33,6 +41,7 @@ knob and never touches stdout.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -47,6 +56,19 @@ class QueueFull(RuntimeError):
     retriable = True
 
 
+class Shed(QueueFull):
+    """Request rejected by SLO-driven admission control before enqueue
+    — retriable after ``retry_after_s`` (the HTTP layer turns it into
+    the 429 ``Retry-After`` header).  ``reason`` says which threshold
+    tripped (``queue_age`` | ``slo_p99``)."""
+
+    def __init__(self, msg: str, *, reason: str,
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+
 class DeadlineExceeded(TimeoutError):
     """Request expired before (or while) being served — retriable."""
 
@@ -55,9 +77,10 @@ class DeadlineExceeded(TimeoutError):
 
 class _Request:
     __slots__ = ("payload", "rows", "deadline", "submitted",
-                 "event", "result", "error", "span", "qspan")
+                 "event", "result", "error", "span", "qspan", "req_id")
 
-    def __init__(self, payload, rows, deadline, submitted, span=None):
+    def __init__(self, payload, rows, deadline, submitted, span=None,
+                 req_id=None):
         self.payload = payload
         self.rows = rows              # device cost: how many batch rows
         self.deadline = deadline      # absolute, in clock() units
@@ -67,6 +90,7 @@ class _Request:
         self.error: BaseException | None = None
         self.span = span              # caller's root span (HPNN_SPANS)
         self.qspan = None             # queue-wait span, closed on pop
+        self.req_id = req_id          # edge-minted id (tracing)
 
     def finish(self, result=None, error: BaseException | None = None):
         self.result = result
@@ -85,6 +109,12 @@ class Batcher:
     ``clock`` must be a monotonic float-seconds callable; tests inject
     a fake.  With ``start=False`` no thread runs — call
     :meth:`drain_once` manually.
+
+    ``shed_age_ms`` / ``shed_p99_ms`` arm SLO-driven admission control
+    (0 disables each; defaults read ``HPNN_SHED_AGE_MS`` /
+    ``HPNN_SHED_P99_MS`` once at construction).  The p99 threshold
+    compares against the rolling-window p99 published by obs/slo.py,
+    so it only bites when ``HPNN_SLO_MS`` is tracking outcomes.
     """
 
     def __init__(
@@ -94,6 +124,8 @@ class Batcher:
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
         max_depth: int = 256,
+        shed_age_ms: float | None = None,
+        shed_p99_ms: float | None = None,
         clock: Callable[[], float] = time.monotonic,
         name: str = "default",
         start: bool = True,
@@ -102,15 +134,25 @@ class Batcher:
             raise ValueError("max_batch must be >= 1")
         if max_depth < 1:
             raise ValueError("max_depth must be >= 1")
+        if shed_age_ms is None:
+            shed_age_ms = float(os.environ.get("HPNN_SHED_AGE_MS", 0)
+                                or 0)
+        if shed_p99_ms is None:
+            shed_p99_ms = float(os.environ.get("HPNN_SHED_P99_MS", 0)
+                                or 0)
         self._dispatch = dispatch
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait_ms) / 1e3
         self.max_depth = int(max_depth)
+        self.shed_age_ms = float(shed_age_ms)
+        self.shed_p99_ms = float(shed_p99_ms)
         self._clock = clock
         self.name = name
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: deque[_Request] = deque()
+        self._shed: dict[str, int] = {}   # cumulative, per reason
+        self._expired = 0                 # cumulative deadline drops
         self._closed = False
         self._thread: threading.Thread | None = None
         if start:
@@ -120,33 +162,66 @@ class Batcher:
             self._thread.start()
 
     # ------------------------------------------------------------ submit
+    def _shed_reason(self, now: float) -> str | None:
+        """Admission-control check (caller holds the lock): the shed
+        reason when a threshold has tripped, else None."""
+        if (self.shed_age_ms > 0 and self._queue
+                and (now - self._queue[0].submitted) * 1e3
+                >= self.shed_age_ms):
+            return "queue_age"
+        if self.shed_p99_ms > 0:
+            p99 = obs.slo.current_p99_ms()
+            if p99 is not None and p99 >= self.shed_p99_ms:
+                return "slo_p99"
+        return None
+
     def submit(self, payload, *, rows: int = 1,
-               timeout_s: float = 5.0, span=None) -> _Request:
+               timeout_s: float = 5.0, span=None,
+               req_id=None) -> _Request:
         """Enqueue one request; returns its ticket (wait via
         :meth:`result`).  Raises :class:`QueueFull` when the queue is
-        at ``max_depth``.  ``span`` (HPNN_SPANS) is the caller's root
-        span: the queue-wait child opens here and closes when the
-        drain loop pops (or expires) the request, so queue time is
-        attributable separately from dispatch time."""
+        at ``max_depth`` and :class:`Shed` when admission control
+        trips.  ``span`` (HPNN_SPANS) is the caller's root span: the
+        queue-wait child opens here and closes when the drain loop
+        pops (or expires) the request, so queue time is attributable
+        separately from dispatch time.  ``req_id`` (edge-minted) rides
+        the queue span so ``obs_report --spans --req`` can reconstruct
+        one request's breakdown."""
         if rows < 1:
             raise ValueError("rows must be >= 1")
         now = self._clock()
         req = _Request(payload, int(rows), now + float(timeout_s), now,
-                       span=span)
-        if obs.spans.enabled():
-            # before the append: the drain thread may pop the request
-            # the instant it lands in the queue
-            req.qspan = obs.spans.start("serve.queue", parent=span,
-                                        batcher=self.name)
+                       span=span, req_id=req_id)
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"batcher {self.name!r} is closed")
+            reason = self._shed_reason(now)
+            if reason is not None:
+                self._shed[reason] = self._shed.get(reason, 0) + 1
+                fields = {"batcher": self.name, "reason": reason}
+                if req_id is not None:
+                    fields["req_id"] = req_id
+                obs.count("serve.shed", **fields)
+                raise Shed(
+                    f"batcher {self.name!r} shedding load "
+                    f"({reason}); retry later", reason=reason)
             if len(self._queue) >= self.max_depth:
+                self._shed["queue_full"] = (
+                    self._shed.get("queue_full", 0) + 1)
                 obs.count("serve.rejected", batcher=self.name,
                           reason="queue_full")
                 raise QueueFull(
                     f"batcher {self.name!r} queue at max_depth="
                     f"{self.max_depth}; retry later")
+            if obs.spans.enabled():
+                # inside the lock, before the append: the drain thread
+                # cannot pop the request until we release, and
+                # spans.start neither locks nor emits
+                qfields = {"batcher": self.name}
+                if req_id is not None:
+                    qfields["req_id"] = req_id
+                req.qspan = obs.spans.start("serve.queue", parent=span,
+                                            **qfields)
             self._queue.append(req)
             depth = len(self._queue)
             self._cond.notify()
@@ -165,10 +240,10 @@ class Batcher:
         return req.result
 
     def infer(self, payload, *, rows: int = 1, timeout_s: float = 5.0,
-              span=None):
+              span=None, req_id=None):
         """submit + result in one call (the common embedding path)."""
         req = self.submit(payload, rows=rows, timeout_s=timeout_s,
-                          span=span)
+                          span=span, req_id=req_id)
         # small slack past the request deadline: the drain loop is the
         # authority on expiry; this wait is just a liveness backstop
         return self.result(req, timeout_s=float(timeout_s) + 1.0)
@@ -185,6 +260,18 @@ class Batcher:
                 return None
             submitted = self._queue[0].submitted
         return max(0.0, self._clock() - submitted)
+
+    def shed_counts(self) -> dict[str, int]:
+        """Cumulative rejected-submit counts per reason
+        (``queue_age`` / ``slo_p99`` / ``queue_full``) — the /healthz
+        shed section."""
+        with self._lock:
+            return dict(self._shed)
+
+    def expired_total(self) -> int:
+        """Cumulative requests dropped in-queue by deadline expiry."""
+        with self._lock:
+            return self._expired
 
     # ------------------------------------------------------------ drain
     def _take_batch(self, block: bool) -> list[_Request] | None:
@@ -222,6 +309,7 @@ class Batcher:
                 batch.append(self._queue.popleft())
                 rows += req.rows
             depth = len(self._queue)
+            self._expired += len(expired)
         for req in expired:
             obs.count("serve.deadline_exceeded", batcher=self.name)
             obs.spans.finish(req.qspan, failed="DeadlineExceeded")

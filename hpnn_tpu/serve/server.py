@@ -11,12 +11,16 @@ The HTTP layer is deliberately thin — stdlib ``http.server`` over the
 same Session, for drivers that aren't Python:
 
 * ``POST /v1/infer``  ``{"kernel": n, "inputs": [...]}`` →
-  ``{"outputs": [...]}``; 404 unknown kernel, 400 malformed,
-  **429** queue full (retriable, ``Retry-After`` set), **504**
-  deadline exceeded (retriable).
+  ``{"outputs": [...], "req_id": ...}``; 404 unknown kernel, 400
+  malformed, **429** queue full or load shed (retriable,
+  ``Retry-After`` set), **504** deadline exceeded (retriable,
+  ``Retry-After`` set).  Every response carries an ``X-Request-Id``
+  (client-sent ``req_id`` honored, else edge-minted) that threads
+  through the request's spans (docs/serving.md).
 * ``POST /v1/reload`` ``{"kernel": n}`` → re-read the kernel file.
 * ``GET /healthz`` → kernel/bucket census, bucket-compile count,
-  per-kernel queue depth + oldest-waiter age, process obs health.
+  per-kernel queue depth + oldest-waiter age + shed/expired
+  counters, SLO verdict, process obs health.
 * ``GET /metrics`` → the obs aggregate snapshot in Prometheus text
   format (obs/export.py; docs/observability.md).
 
@@ -27,7 +31,9 @@ runs inside a driver process.
 
 from __future__ import annotations
 
+import itertools
 import json
+import math
 import os
 import sys
 import threading
@@ -38,7 +44,8 @@ import numpy as np
 
 from hpnn_tpu import obs
 from hpnn_tpu.models import kernel as kernel_mod
-from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull
+from hpnn_tpu.serve.batcher import (Batcher, DeadlineExceeded, QueueFull,
+                                    Shed)
 from hpnn_tpu.serve.engine import (DEFAULT_MAX_BATCH, DEFAULT_N_BUCKETS,
                                    Engine)
 from hpnn_tpu.serve.registry import Registry, RegistryError
@@ -65,6 +72,8 @@ class Session:
     def __init__(self, *, max_batch: int = DEFAULT_MAX_BATCH,
                  n_buckets: int = DEFAULT_N_BUCKETS,
                  max_wait_ms: float = 2.0, max_depth: int = 256,
+                 shed_age_ms: float | None = None,
+                 shed_p99_ms: float | None = None,
                  clock=time.monotonic, start: bool = True,
                  mode: str | None = None, fleet: bool | None = None):
         self.registry = Registry()
@@ -72,6 +81,8 @@ class Session:
                              n_buckets=n_buckets, mode=mode)
         self.max_wait_ms = float(max_wait_ms)
         self.max_depth = int(max_depth)
+        self.shed_age_ms = shed_age_ms    # None → batcher reads env
+        self.shed_p99_ms = shed_p99_ms
         if fleet is None:
             fleet = os.environ.get("HPNN_SERVE_FLEET", "") == "1"
         self.fleet = bool(fleet)
@@ -121,7 +132,8 @@ class Session:
 
     def health(self) -> dict:
         """The /healthz document: kernel census, bucket-compile census,
-        and per-batcher queue depth + oldest-waiter age."""
+        per-batcher queue depth + oldest-waiter age + cumulative
+        shed/expired counters, and the SLO verdict (obs/slo.py)."""
         with self._lock:
             batchers = dict(self._batchers)
         doc = {
@@ -132,12 +144,15 @@ class Session:
             "compile_cache": self.engine.cache_stats(),
             "batchers": {
                 name: {"depth": b.depth(),
-                       "oldest_wait_s": b.oldest_age()}
+                       "oldest_wait_s": b.oldest_age(),
+                       "shed": b.shed_counts(),
+                       "expired": b.expired_total()}
                 for name, b in batchers.items()
             },
         }
         doc["numerics"] = obs.probes.health_doc(self.registry.names())
         doc["obs"] = obs.export.health()
+        doc["slo"] = obs.slo.health_doc()
         return doc
 
     # ------------------------------------------------------------ infer
@@ -157,6 +172,8 @@ class Session:
                         max_batch=self.engine.max_batch,
                         max_wait_ms=self.max_wait_ms,
                         max_depth=self.max_depth,
+                        shed_age_ms=self.shed_age_ms,
+                        shed_p99_ms=self.shed_p99_ms,
                         clock=self._clock, name=bname,
                         start=self._start)
                 else:
@@ -166,18 +183,24 @@ class Session:
                         max_batch=self.engine.max_batch,
                         max_wait_ms=self.max_wait_ms,
                         max_depth=self.max_depth,
+                        shed_age_ms=self.shed_age_ms,
+                        shed_p99_ms=self.shed_p99_ms,
                         clock=self._clock, name=name,
                         start=self._start)
                 self._batchers[bname] = b
         return b
 
-    def infer(self, name: str, x, *, timeout_s: float = 5.0):
+    def infer(self, name: str, x, *, timeout_s: float = 5.0,
+              req_id: str | None = None):
         """Forward ``x`` through kernel ``name`` via the micro-batcher.
 
         ``x`` may be one input vector ``(n_in,)`` → returns
         ``(n_out,)``, or a row block ``(R, n_in)`` → returns
         ``(R, n_out)``.  Raises :class:`KeyError` (unknown kernel),
         :class:`QueueFull` / :class:`DeadlineExceeded` (retriable).
+        ``req_id`` (HTTP-edge minted) is threaded onto the request's
+        spans and the outcome lands in the SLO tracker
+        (``HPNN_SLO_MS``; obs/slo.py).
         """
         arr = np.asarray(x)
         single = arr.ndim == 1
@@ -186,17 +209,36 @@ class Session:
         payload = (name, rows) if self.fleet else rows
         # root of the request lifecycle: serve.queue / serve.dispatch
         # children hang off it across the batcher threads (HPNN_SPANS)
-        span = obs.spans.start("serve.request", kernel=name,
-                               rows=rows.shape[0])
+        sfields = {"kernel": name, "rows": rows.shape[0]}
+        if req_id is not None:
+            sfields["req_id"] = req_id
+        span = obs.spans.start("serve.request", **sfields)
+        slo_on = obs.slo.enabled()
+        t0 = self._clock() if slo_on else 0.0
         try:
             with obs.timer("serve.request", kernel=name,
                            rows=rows.shape[0]):
                 out = batcher.infer(payload, rows=rows.shape[0],
-                                    timeout_s=timeout_s, span=span)
+                                    timeout_s=timeout_s, span=span,
+                                    req_id=req_id)
+        except QueueFull as exc:  # Shed is a QueueFull subclass
+            obs.spans.finish(span, failed=type(exc).__name__)
+            if slo_on:
+                obs.slo.record("shed")
+            raise
+        except DeadlineExceeded as exc:
+            obs.spans.finish(span, failed=type(exc).__name__)
+            if slo_on:
+                obs.slo.record("expired")
+            raise
         except BaseException as exc:
             obs.spans.finish(span, failed=type(exc).__name__)
+            if slo_on:
+                obs.slo.record("error")
             raise
         obs.spans.finish(span)
+        if slo_on:
+            obs.slo.record("ok", latency_s=self._clock() - t0)
         return out[0] if single else out
 
     # ------------------------------------------------------------ close
@@ -209,9 +251,28 @@ class Session:
             b.close()
 
 
+# edge-minted request-id suffix: unique within the process, cheap
+_REQ_IDS = itertools.count(1)
+
+
+def _retry_after(exc: QueueFull) -> str:
+    """The Retry-After header value for a retriable rejection."""
+    if isinstance(exc, Shed):
+        return str(max(1, int(math.ceil(exc.retry_after_s))))
+    return "1"
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "hpnn-serve/0.1"
+    # one TCP segment per response: with the default unbuffered wfile,
+    # status/headers and body go out as separate segments and Nagle +
+    # delayed ACK stall the body ~40 ms on loopback — which dominated
+    # every request until the load harness exposed it.  Buffered
+    # writes (handle_one_request flushes per response, so keep-alive
+    # stays correct) + TCP_NODELAY remove the stall.
+    wbufsize = -1
+    disable_nagle_algorithm = True
 
     # stdout is the token protocol's — request logs go to stderr
     def log_message(self, fmt, *args):
@@ -279,20 +340,39 @@ class _Handler(BaseHTTPRequestHandler):
                                        "list of vectors"})
             return
         timeout_s = float(req.get("timeout_s", 5.0))
+        # the request id is minted here at the edge (client-sent ids
+        # are honored) and rides every span + the response, so loadgen
+        # runs cross-correlate with obs_report --spans --req <id>
+        req_id = req.get("req_id")
+        if not isinstance(req_id, str) or not req_id:
+            req_id = f"{os.getpid():x}-{next(_REQ_IDS):x}"
+        rid_hdr = {"X-Request-Id": req_id}
         try:
-            out = self.session.infer(name, inputs, timeout_s=timeout_s)
+            out = self.session.infer(name, inputs, timeout_s=timeout_s,
+                                     req_id=req_id)
         except KeyError:
-            self._reply(404, {"error": f"unknown kernel {name!r}"})
-        except QueueFull as exc:
-            self._reply(429, {"error": str(exc), "retriable": True},
-                        headers={"Retry-After": "1"})
+            self._reply(404, {"error": f"unknown kernel {name!r}",
+                              "req_id": req_id}, headers=rid_hdr)
+        except QueueFull as exc:  # Shed included: both map to 429
+            body = {"error": str(exc), "retriable": True,
+                    "req_id": req_id}
+            if isinstance(exc, Shed):
+                body["reason"] = exc.reason
+            self._reply(429, body,
+                        headers={"Retry-After": _retry_after(exc),
+                                 **rid_hdr})
         except DeadlineExceeded as exc:
-            self._reply(504, {"error": str(exc), "retriable": True})
+            # retriable like 429, so it carries the same header
+            self._reply(504, {"error": str(exc), "retriable": True,
+                              "req_id": req_id},
+                        headers={"Retry-After": "1", **rid_hdr})
         except ValueError as exc:
-            self._reply(400, {"error": str(exc)})
+            self._reply(400, {"error": str(exc), "req_id": req_id},
+                        headers=rid_hdr)
         else:
-            self._reply(200, {"kernel": name,
-                              "outputs": np.asarray(out).tolist()})
+            self._reply(200, {"kernel": name, "req_id": req_id,
+                              "outputs": np.asarray(out).tolist()},
+                        headers=rid_hdr)
 
     def _reload(self, req: dict):
         name = req.get("kernel", "default")

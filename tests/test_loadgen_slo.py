@@ -1,0 +1,591 @@
+"""Load harness + SLO observability (obs/slo.py, batcher shedding,
+tools/loadgen.py; docs/observability.md "SLOs and load").
+
+Acceptance bar (ISSUE): a tier-1 test drives the HTTP loadgen against
+an in-process server (CPU, small kernel) and asserts the ``slo.*``
+gauges, ``serve.shed`` events and ``/healthz`` shed counters appear —
+and that the sink lints clean under ``check_obs_catalog.py --slo``.
+The tracker, the admission control, and the deadline-vs-submit race
+are asserted with fake clocks and zero sleeps.
+"""
+
+import http.client
+import importlib
+import importlib.util
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import slo
+from hpnn_tpu.serve import batcher as batcher_mod
+from hpnn_tpu.serve.server import make_server
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _import_tool(name):
+    """Import a tool as a real module (shared ``sys.modules`` entry,
+    so cross-tool ``from loadgen import ...`` resolves to the same
+    object — the helper-sharing identity test needs that)."""
+    tools = os.path.join(ROOT, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    return importlib.import_module(name)
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _kernel(seed=7):
+    k, _ = kernel_mod.generate(seed, 8, [5], 2)
+    return k
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def slo_env():
+    """Leave no SLO/shed env state behind (``slo.configure`` writes
+    ``os.environ`` directly, so monkeypatch can't track it)."""
+    yield
+    for key in (slo.ENV_KNOB, slo.ENV_WINDOW, slo.ENV_TARGET,
+                "HPNN_SHED_AGE_MS", "HPNN_SHED_P99_MS"):
+        os.environ.pop(key, None)
+    slo._reset_for_tests()
+
+
+# -------------------------------------------------------------- tracker
+def test_tracker_percentiles_attainment_burn():
+    clock = FakeClock()
+    tr = slo.Tracker(50.0, window_s=100.0, target=0.9, clock=clock)
+    lats_ms = list(range(1, 101))            # 1..100 ms, half within
+    for ms in lats_ms:
+        tr.record("ok", latency_s=ms / 1e3)
+    snap = tr.snapshot()
+    assert snap["requests"] == snap["served"] == 100
+    assert snap["shed"] == 0
+    assert snap["p50_ms"] == pytest.approx(
+        float(np.percentile(lats_ms, 50)), abs=1e-6)
+    assert snap["p99_ms"] == pytest.approx(
+        float(np.percentile(lats_ms, 99)), abs=1e-6)
+    assert snap["attainment"] == pytest.approx(0.5)
+    assert snap["burn_rate"] == pytest.approx(0.5 / 0.1, rel=1e-4)
+    assert snap["verdict"] == "breach"
+    # shed outcomes are excluded from both percentiles and attainment
+    for _ in range(10):
+        tr.record("shed")
+    snap = tr.snapshot()
+    assert (snap["requests"], snap["served"], snap["shed"]) \
+        == (110, 100, 10)
+    assert snap["attainment"] == pytest.approx(0.5)
+    # an expired request is a completed miss
+    tr.record("expired")
+    snap = tr.snapshot()
+    assert snap["attainment"] == pytest.approx(50 / 101)
+
+
+def test_tracker_window_prunes_and_empty_window_is_ok():
+    clock = FakeClock()
+    tr = slo.Tracker(50.0, window_s=10.0, clock=clock)
+    tr.record("ok", latency_s=0.010)
+    clock.advance(5.0)
+    tr.record("ok", latency_s=0.020)
+    assert tr.snapshot()["requests"] == 2
+    clock.advance(6.0)                       # t=11: the t=0 entry ages out
+    tr.record("ok", latency_s=0.030)
+    assert tr.snapshot()["requests"] == 2
+    clock.advance(20.0)                      # everything ages out
+    snap = tr.snapshot()
+    assert snap["requests"] == 0 and snap["served"] == 0
+    assert snap["p50_ms"] is None and snap["p99_ms"] is None
+    assert snap["attainment"] == 1.0         # vacuous window: no breach
+    assert snap["verdict"] == "ok"
+
+
+def test_tracker_validates_arguments():
+    with pytest.raises(ValueError):
+        slo.Tracker(0.0)
+    with pytest.raises(ValueError):
+        slo.Tracker(50.0, target=1.0)
+
+
+def test_slo_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv(slo.ENV_KNOB, raising=False)
+    slo._reset_for_tests()
+    assert not slo.enabled()
+    slo.record("ok", 0.001)                  # must not build a tracker
+    assert slo._tracker is None
+    assert slo.current_p99_ms() is None
+    assert slo.health_doc() == {"mode": "off"}
+
+
+def test_configure_publish_gauges_and_current_p99(tmp_path, monkeypatch,
+                                                  slo_env):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    clock = FakeClock()
+    slo.configure(50.0, window_s=30.0, target=0.9, clock=clock)
+    assert slo.enabled()
+    slo.record("ok", latency_s=0.2)          # first record publishes
+    assert slo.current_p99_ms() == pytest.approx(200.0)
+    doc = slo.health_doc()
+    assert doc["mode"] == "on" and doc["slo_ms"] == 50.0
+    assert doc["verdict"] == "breach"
+    obs.flush()
+    evs = {r["ev"] for r in _read(sink)}
+    assert {"slo.p50_ms", "slo.p99_ms", "slo.attainment",
+            "slo.burn_rate", "slo.window_requests"} <= evs
+    # disarm: back to the no-op contract
+    slo.configure(None)
+    assert not slo.enabled()
+    assert slo.health_doc() == {"mode": "off"}
+
+
+# ---------------------------------------------- quantile interpolation
+def test_quantile_estimate_round_trips_through_the_registry(
+        tmp_path, monkeypatch):
+    """Observe a latency-shaped sample through the real registry, then
+    recover quantiles from its log2 buckets: each estimate stays
+    within the landing bucket (≤2x of exact, vs the old upper-bound
+    answer), is monotone in q, and collapses exactly for point
+    distributions (the [min, max] clamp)."""
+    from hpnn_tpu.obs import export as export_mod
+
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    rng = np.random.RandomState(3)
+    samples = rng.lognormal(mean=1.0, sigma=1.2, size=4000)
+    obs.observe("h", samples)
+    obs.summary()
+    obs.flush()
+    agg = next(r for r in _read(sink)
+               if r["ev"] == "obs.summary")["aggregates"]["h"]
+    ests = []
+    for q in (0.5, 0.9, 0.99):
+        est = export_mod._quantile_estimate(agg, q)
+        exact = float(np.percentile(samples, q * 100))
+        assert 0.5 <= est / exact <= 2.0, (q, est, exact)
+        ests.append(est)
+    assert ests == sorted(ests)
+    assert agg["min"] <= ests[0] and ests[-1] <= agg["max"]
+    # point distribution: interpolation + clamp answer the value itself
+    point = {"n": 9, "min": 17.0, "max": 17.0,
+             "log2_buckets": {str(math.frexp(17.0)[1]): 9}}
+    for q in (0.5, 0.99):
+        assert export_mod._quantile_estimate(point, q) == 17.0
+
+
+# ------------------------------------------------------------- shedding
+def test_batcher_sheds_on_queue_age(tmp_path, monkeypatch):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    obs._reset_for_tests()
+    clock = FakeClock()
+    b = batcher_mod.Batcher(lambda p: list(p), shed_age_ms=10.0,
+                            clock=clock, start=False, name="aged")
+    first = b.submit("a")                    # empty queue always admits
+    clock.advance(0.005)
+    b.submit("b")                            # 5 ms < threshold: admitted
+    clock.advance(0.006)
+    with pytest.raises(batcher_mod.Shed) as ei:
+        b.submit("c", req_id="r-1")
+    assert ei.value.reason == "queue_age"
+    assert ei.value.retriable and ei.value.retry_after_s > 0
+    assert isinstance(ei.value, batcher_mod.QueueFull)  # same 429 path
+    assert b.shed_counts() == {"queue_age": 1}
+    assert b.drain_once() == 2               # the admitted ones survive
+    assert b.result(first, timeout_s=0) == "a"
+    obs.flush()
+    shed = [r for r in _read(sink) if r["ev"] == "serve.shed"]
+    assert len(shed) == 1
+    assert shed[0]["kind"] == "count"
+    assert (shed[0]["batcher"], shed[0]["reason"], shed[0]["req_id"]) \
+        == ("aged", "queue_age", "r-1")
+    b.close()
+
+
+def test_batcher_sheds_on_windowed_p99(slo_env):
+    clock = FakeClock()
+    slo.configure(50.0, clock=clock)
+    slo.record("ok", latency_s=0.2)          # published p99 = 200 ms
+    assert slo.current_p99_ms() == pytest.approx(200.0)
+    b = batcher_mod.Batcher(lambda p: list(p), shed_p99_ms=100.0,
+                            clock=clock, start=False, name="p99")
+    with pytest.raises(batcher_mod.Shed) as ei:
+        b.submit("x")                        # even an empty queue sheds
+    assert ei.value.reason == "slo_p99"
+    assert b.shed_counts() == {"slo_p99": 1}
+    slo.configure(None)                      # tracker off → p99 unknown
+    b.submit("y")                            # → admission resumes
+    assert b.drain_once() == 1
+    b.close()
+
+
+def test_batcher_shed_knobs_read_env_once(monkeypatch):
+    monkeypatch.setenv("HPNN_SHED_AGE_MS", "7.5")
+    monkeypatch.setenv("HPNN_SHED_P99_MS", "120")
+    b = batcher_mod.Batcher(lambda p: list(p), start=False)
+    assert (b.shed_age_ms, b.shed_p99_ms) == (7.5, 120.0)
+    b2 = batcher_mod.Batcher(lambda p: list(p), shed_age_ms=0,
+                             shed_p99_ms=0, start=False)
+    assert (b2.shed_age_ms, b2.shed_p99_ms) == (0.0, 0.0)  # explicit off
+    b.close()
+    b2.close()
+
+
+def test_queue_full_lands_in_the_shed_census():
+    clock = FakeClock()
+    b = batcher_mod.Batcher(lambda p: list(p), max_depth=1,
+                            clock=clock, start=False)
+    b.submit("a")
+    with pytest.raises(batcher_mod.QueueFull) as ei:
+        b.submit("b")
+    assert not isinstance(ei.value, batcher_mod.Shed)
+    assert b.shed_counts() == {"queue_full": 1}
+    b.close()
+
+
+# --------------------------------------------------------- expiry race
+def test_deadline_expiry_races_a_concurrent_submit(tmp_path,
+                                                   monkeypatch):
+    """A request expiring in-queue while another submit lands
+    mid-dispatch (dispatch runs outside the lock, so a concurrent
+    submit is legal there): the expired ticket fails with
+    DeadlineExceeded and a closed ``serve.queue`` span, the live one
+    is served, and the raced submit is admitted and served next —
+    fake clock, no sleeps."""
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    obs._reset_for_tests()
+    clock = FakeClock()
+    holder, raced = [], []
+
+    def dispatch(payloads):
+        if not raced:                        # submit DURING dispatch
+            raced.append(holder[0].submit("raced", timeout_s=5.0))
+        return list(payloads)
+
+    b = batcher_mod.Batcher(dispatch, clock=clock, start=False,
+                            name="race")
+    holder.append(b)
+    r1 = b.submit("doomed", timeout_s=1.0, req_id="race-1")
+    r2 = b.submit("alive", timeout_s=10.0, req_id="race-2")
+    clock.advance(2.0)                       # r1 past its deadline
+    assert b.drain_once() == 1               # r2 only; r1 never dispatched
+    with pytest.raises(batcher_mod.DeadlineExceeded):
+        b.result(r1, timeout_s=0)
+    assert b.result(r2, timeout_s=0) == "alive"
+    assert b.expired_total() == 1
+    assert b.drain_once() == 1               # the raced request survives
+    assert b.result(raced[0], timeout_s=0) == "raced"
+    obs.flush()
+    recs = _read(sink)
+    qspans = [r for r in recs
+              if r["ev"] == "span.end" and r["name"] == "serve.queue"]
+    assert len(qspans) == 3
+    by_req = {r.get("req_id"): r for r in qspans}
+    assert by_req["race-1"]["failed"] == "DeadlineExceeded"
+    assert "failed" not in by_req["race-2"]
+    assert any(r["ev"] == "serve.deadline_exceeded" for r in recs)
+    b.close()
+
+
+# ------------------------------------------------------- HTTP contract
+def _post(port, body, timeout=10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", "/v1/infer", body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return (resp.status, dict(resp.getheaders()),
+                json.loads(resp.read() or b"{}"))
+    finally:
+        conn.close()
+
+
+def test_http_retry_contract_and_request_ids():
+    """429-shed carries Retry-After + reason, 504 carries Retry-After,
+    and every response echoes X-Request-Id (client-sent ids honored,
+    else edge-minted).  The session runs drainless on a fake clock;
+    the test steps the batcher by hand."""
+    clock = FakeClock()
+    session = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0,
+                            shed_age_ms=10.0, clock=clock, start=False)
+    session.register_kernel("k", _kernel())
+    b = session.batcher_for("k")
+    server = make_server(session, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    results = {}
+
+    def post_bg(key, body):
+        results[key] = _post(port, body)
+
+    try:
+        # 200: a client-sent req_id round-trips header and body
+        t = threading.Thread(target=post_bg, args=(
+            "ok", {"kernel": "k", "inputs": [0.1] * 8,
+                   "req_id": "abc-1"}))
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while b.depth() < 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert b.drain_once() == 1
+        t.join(timeout=5.0)
+        code, headers, body = results["ok"]
+        assert code == 200
+        assert headers.get("X-Request-Id") == "abc-1"
+        assert body["req_id"] == "abc-1"
+
+        # 429 shed: park one request, age it past the threshold
+        t = threading.Thread(target=post_bg, args=(
+            "parked", {"kernel": "k", "inputs": [0.1] * 8,
+                       "timeout_s": 5.0}))
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while b.depth() < 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        clock.advance(0.02)                  # 20 ms ≥ shed_age_ms
+        code, headers, body = _post(
+            port, {"kernel": "k", "inputs": [0.1] * 8,
+                   "req_id": "cafe"})
+        assert code == 429
+        assert int(headers["Retry-After"]) >= 1
+        assert headers.get("X-Request-Id") == "cafe"
+        assert body["reason"] == "queue_age" and body["retriable"]
+        assert session.health()["batchers"]["k"]["shed"] \
+            == {"queue_age": 1}
+
+        # 504: expire the parked request in-queue
+        clock.advance(10.0)
+        assert b.drain_once() == 0           # all-expired batch
+        t.join(timeout=5.0)
+        code, headers, body = results["parked"]
+        assert code == 504
+        assert headers["Retry-After"] == "1"
+        assert headers.get("X-Request-Id")   # edge-minted, non-empty
+        assert body["retriable"]
+        assert session.health()["batchers"]["k"]["expired"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        session.close()
+
+
+# ------------------------------------------------- loadgen helpers/CLI
+def test_bench_serve_shares_loadgen_percentiles():
+    loadgen = _import_tool("loadgen")
+    bench_serve = _import_tool("bench_serve")
+    assert bench_serve.percentile_ms is loadgen.percentile_ms
+    assert bench_serve.latency_summary is loadgen.latency_summary
+
+
+def test_loadgen_summaries_and_arrivals(tmp_path):
+    loadgen = _import_tool("loadgen")
+    assert loadgen.percentile_ms([0.001, 0.002, 0.003], 50) == 2.0
+    recs = ([{"status": "ok", "latency_ms": 10.0}] * 8
+            + [{"status": "shed", "latency_ms": 1.0}] * 2)
+    s = loadgen.summarize(recs, 2.0, offered_rps=10.0)
+    assert (s["requests"], s["ok"], s["shed"]) == (10, 8, 2)
+    assert s["goodput_rps"] == 4.0
+    assert s["goodput_vs_offered"] == pytest.approx(0.4)
+    assert s["shed_rate"] == pytest.approx(0.2)
+    assert s["latency_ms"]["p50"] == 10.0    # served latencies only
+    empty = loadgen.summarize([], 1.0)
+    assert empty["latency_ms"]["p99"] is None
+    # arrivals: rates hit the long-run average, stay sorted + in-range
+    rng = np.random.RandomState(0)
+    arr = loadgen.poisson_arrivals(200.0, 10.0, rng)
+    assert arr == sorted(arr) and 0 < arr[-1] < 10.0
+    assert len(arr) == pytest.approx(2000, rel=0.15)
+    brr = loadgen.burst_arrivals(200.0, 10.0, rng)
+    assert len(brr) == pytest.approx(2000, rel=0.15)
+    with pytest.raises(ValueError):
+        loadgen.make_arrivals("nope", 1.0, 1.0, rng)
+    out = tmp_path / "r.jsonl"
+    loadgen.write_jsonl(str(out), recs, s)
+    rows = _read(out)
+    assert len(rows) == 11 and rows[-1]["summary"]["ok"] == 8
+
+
+# ----------------------------------------------------- acceptance (e2e)
+def test_loadgen_against_live_server_slo_observability(
+        tmp_path, monkeypatch, slo_env, capsys):
+    """The ISSUE acceptance test: loadgen drives an in-process HTTP
+    server (CPU, 8-5-2 kernel) with the SLO tracker and queue-age
+    shedding armed.  Requests are both served and shed; the ``slo.*``
+    gauges, ``serve.shed`` events and ``/healthz`` shed counters all
+    appear; the sink lints clean under ``--slo``; and a served
+    request's X-Request-Id reconstructs its span tree via
+    ``obs_report --spans --req``."""
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    obs._reset_for_tests()
+    slo.configure(50.0, window_s=60.0)
+    loadgen = _import_tool("loadgen")
+    session = serve.Session(max_batch=16, n_buckets=3, max_wait_ms=1.0,
+                            max_depth=64, shed_age_ms=0.05)
+    session.register_kernel("k", _kernel())
+    server = make_server(session, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    run_path = tmp_path / "run.jsonl"
+    try:
+        summary = loadgen.run_closed_loop(
+            f"http://127.0.0.1:{port}", n_clients=4, duration_s=1.0,
+            kernels=("k",), rows_choices=(1, 2), n_in=8, timeout_s=2.0,
+            max_retries=0, out_path=str(run_path))
+        assert summary["ok"] > 0, summary
+        assert summary["shed"] > 0, summary
+        assert summary["latency_ms"]["p99"] is not None
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            conn.request("GET", "/metrics")
+            metrics = conn.getresponse().read()
+        finally:
+            conn.close()
+        assert health["batchers"]["k"]["shed"].get("queue_age", 0) > 0
+        assert health["slo"]["mode"] == "on"
+        assert health["slo"]["requests"] > 0
+        assert b"hpnn_slo_attainment" in metrics
+        assert b"hpnn_serve_shed" in metrics
+    finally:
+        server.shutdown()
+        server.server_close()
+        session.close()
+
+    obs.flush()
+    recs = _read(sink)
+    assert any(r["ev"] == "slo.p99_ms" for r in recs)
+    assert any(r["ev"] == "serve.shed" for r in recs)
+    # every outcome row carries the server-minted id
+    rows = [r for r in _read(run_path) if "summary" not in r]
+    ok_ids = [r["req_id"] for r in rows if r["status"] == "ok"]
+    assert ok_ids and all(ok_ids)
+    # the sink lints clean under the --slo schema lint
+    cat = _load_tool("check_obs_catalog")
+    assert cat.lint_slo(str(sink)) == []
+    assert cat.main(["--slo", str(sink)]) == 0
+    # request-id reconstruction: the span report narrows to one request
+    rep = _load_tool("obs_report")
+    assert rep.main([str(sink), "--spans", "--req", ok_ids[0]]) == 0
+    out = capsys.readouterr().out
+    assert f"req_id: {ok_ids[0]}" in out
+    assert "serve.request" in out
+
+
+# ------------------------------------------------------- --slo lint
+def _slo_gauge(name, value, **over):
+    rec = {"ts": 0.0, "ev": name, "kind": "gauge", "value": value}
+    rec.update(over)
+    return rec
+
+
+def _shed_rec(**over):
+    rec = {"ts": 0.0, "ev": "serve.shed", "kind": "count", "n": 1,
+           "total": 1, "batcher": "k", "reason": "queue_age"}
+    rec.update(over)
+    return rec
+
+
+def _write_sink(path, recs):
+    with open(path, "w") as fp:
+        for rec in recs:
+            fp.write(json.dumps(rec) + "\n")
+
+
+def test_slo_lint_accepts_a_well_formed_sink(tmp_path):
+    cat = _load_tool("check_obs_catalog")
+    path = tmp_path / "m.jsonl"
+    _write_sink(path, [
+        _slo_gauge("slo.p50_ms", 1.5),
+        _slo_gauge("slo.p99_ms", 12.0),
+        _slo_gauge("slo.attainment", 0.995),
+        _slo_gauge("slo.burn_rate", 0.5),
+        _slo_gauge("slo.window_requests", 40),
+        _shed_rec(),
+        _shed_rec(reason="slo_p99", req_id="a-1"),
+        {"ts": 0.0, "ev": "span.end", "kind": "event", "span": 1,
+         "parent": None, "name": "serve.queue", "t0": 0.0, "dt": 0.1,
+         "req_id": "a-1"},
+        {"ts": 0.0, "ev": "round.start", "kind": "event"},  # bystander
+    ])
+    assert cat.lint_slo(str(path)) == []
+    assert cat.main(["--slo", str(path)]) == 0
+
+
+def test_slo_lint_catches_every_schema_break(tmp_path):
+    """Each clause bites: out-of-range attainment, negative latency,
+    wrong kinds, empty reason/req_id, an unarmed sink, and an
+    unreadable path."""
+    cat = _load_tool("check_obs_catalog")
+    path = tmp_path / "m.jsonl"
+
+    _write_sink(path, [_slo_gauge("slo.attainment", 1.5), _shed_rec()])
+    assert any("outside [0, 1]" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [_slo_gauge("slo.p99_ms", -2.0), _shed_rec()])
+    assert any("negative" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [_slo_gauge("slo.burn_rate", 1.0, kind="count"),
+                       _shed_rec()])
+    assert any("'gauge'" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [_slo_gauge("slo.p50_ms", None), _shed_rec()])
+    assert any("finite" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [_shed_rec(reason="")])
+    assert any("reason" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [_shed_rec(kind="gauge")])
+    assert any("'count'" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [_shed_rec(req_id="")])
+    assert any("req_id" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [{"ts": 0.0, "ev": "span.end", "kind": "event",
+                        "name": "serve.request", "req_id": ""},
+                       _shed_rec()])
+    assert any("span req_id" in f for f in cat.lint_slo(str(path)))
+
+    _write_sink(path, [{"ts": 0.0, "ev": "round.start",
+                        "kind": "event"}])
+    assert any("no slo.*" in f for f in cat.lint_slo(str(path)))
+
+    assert cat.lint_slo(str(tmp_path / "missing.jsonl"))
+    assert cat.main(["--slo"]) == 2

@@ -53,6 +53,13 @@ GATE_METRICS = {
     "serve_p50_ms": ("lower", 0.60),
     "serve_p99_ms": ("lower", 1.00),
     "obs_overhead_pct": ("lower", 2.00),
+    # loadgen fold-in (tools/loadgen.py run_bench_load): goodput under
+    # 2x-saturation offered load, the windowed p99 of accepted
+    # requests, and how close the shed goodput held to the saturation
+    # plateau — latency under load is a guarded surface now too
+    "load_goodput_rps": ("higher", 0.40),
+    "load_p99_ms": ("lower", 1.00),
+    "load_goodput_vs_saturation": ("higher", 0.20),
 }
 
 

@@ -34,9 +34,12 @@ import time
 
 import numpy as np
 
-
-def _percentile_ms(lat_s: list[float], q: float) -> float:
-    return round(float(np.percentile(np.asarray(lat_s) * 1e3, q)), 3)
+# latency definitions are shared with the open-loop generator so the
+# closed-loop bench and loadgen report identically-defined numbers
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+from loadgen import latency_summary, percentile_ms  # noqa: E402
 
 
 def run_serve_bench(
@@ -101,12 +104,7 @@ def run_serve_bench(
         "wall_s": round(wall_s, 3),
         "throughput_rps": round(len(lat) / wall_s, 1) if wall_s else 0.0,
         "rows_per_s": round(sum(rows_done) / wall_s, 1) if wall_s else 0.0,
-        "latency_ms": {
-            "p50": _percentile_ms(lat, 50) if lat else None,
-            "p99": _percentile_ms(lat, 99) if lat else None,
-            "mean": round(float(np.mean(lat)) * 1e3, 3) if lat else None,
-            "max": round(float(np.max(lat)) * 1e3, 3) if lat else None,
-        },
+        "latency_ms": latency_summary(lat),
         "warmup_s": round(warmup_s, 3),
         "buckets": list(session.engine.buckets),
         "compiled_after_warmup": compiled_after_warmup,

@@ -34,9 +34,16 @@ records (HPNN_SPANS / HPNN_COST, hpnn_tpu/obs/{spans,cost}.py) feed
 shapes — and the child-inside-parent span nesting the latency tree
 depends on — are checked the same way the ledger rows are.
 
+And the SLO/shedding schema lint (:func:`lint_slo`): ``slo.*``
+gauges (HPNN_SLO_MS, hpnn_tpu/obs/slo.py), ``serve.shed`` counts and
+the request-id span attributes feed the load harness
+(tools/loadgen.py) and /healthz verdicts, so their shapes are checked
+too.
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
+        [--slo PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -383,6 +390,102 @@ def lint_perf(path: str) -> list[str]:
     return failures
 
 
+# the SLO/shedding record contracts (obs/slo.py, serve/batcher.py;
+# docs/observability.md "SLOs and load")
+SLO_GAUGES = ("slo.p50_ms", "slo.p99_ms", "slo.attainment",
+              "slo.burn_rate", "slo.window_requests")
+
+
+def lint_slo(path: str) -> list[str]:
+    """Schema-lint the SLO/shedding records of one metrics sink.
+
+    Checks, per record:
+
+    * ``slo.*`` gauges — ``kind == "gauge"``, finite ``value``;
+      ``slo.attainment`` in [0, 1]; ``slo.burn_rate`` and the
+      latency/window gauges non-negative.
+    * ``serve.shed`` — ``kind == "count"``; non-empty string
+      ``batcher`` and ``reason``; ``req_id``, when present, a
+      non-empty string.
+    * ``span.end`` records named ``serve.request``/``serve.queue`` —
+      a ``req_id`` field, when present, is a non-empty string (the
+      edge-minted id contract that ``obs_report --spans --req``
+      relies on).
+
+    A sink with neither ``slo.*`` gauges nor ``serve.shed`` records
+    fails — this lint only makes sense on a run where the SLO layer
+    was armed (``HPNN_SLO_MS`` + shed thresholds).  Returns failure
+    strings (empty = pass).
+    """
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_slo = 0
+    n_shed = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if isinstance(ev, str) and ev.startswith("slo."):
+            n_slo += 1
+            if rec.get("kind") != "gauge":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'gauge'")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v):
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a finite number")
+                continue
+            if ev == "slo.attainment" and not 0.0 <= v <= 1.0:
+                failures.append(
+                    f"{at}: slo.attainment {v!r} outside [0, 1]")
+            elif ev != "slo.attainment" and v < 0:
+                failures.append(
+                    f"{at}: {ev} value {v!r} is negative")
+        elif ev == "serve.shed":
+            n_shed += 1
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: serve.shed kind {rec.get('kind')!r} "
+                    "!= 'count'")
+            for key in ("batcher", "reason"):
+                v = rec.get(key)
+                if not isinstance(v, str) or not v:
+                    failures.append(
+                        f"{at}: serve.shed {key} {v!r} is not a "
+                        "non-empty string")
+            rid = rec.get("req_id")
+            if rid is not None and (not isinstance(rid, str)
+                                    or not rid):
+                failures.append(
+                    f"{at}: serve.shed req_id {rid!r} is not a "
+                    "non-empty string")
+        elif ev == "span.end" and rec.get("name") in ("serve.request",
+                                                      "serve.queue"):
+            rid = rec.get("req_id")
+            if rid is not None and (not isinstance(rid, str)
+                                    or not rid):
+                failures.append(
+                    f"{at}: {rec.get('name')} span req_id {rid!r} is "
+                    "not a non-empty string")
+    if not n_slo and not n_shed:
+        failures.append(
+            f"sink {path!r} has no slo.* gauges or serve.shed records "
+            "— were HPNN_SLO_MS and the shed thresholds set?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -399,6 +502,12 @@ def main(argv: list[str] | None = None) -> int:
             sys.stderr.write("check_obs_catalog: --perf needs a path\n")
             return 2
         failures += lint_perf(argv[i + 1])
+    if "--slo" in argv:
+        i = argv.index("--slo")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --slo needs a path\n")
+            return 2
+        failures += lint_slo(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

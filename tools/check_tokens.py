@@ -20,7 +20,10 @@ The instrumented run enables the whole surface at once — JSONL sink,
 flight-recorder ring (``HPNN_FLIGHT``), device telemetry, numerics
 probes + sentinel + checksum ledger (``HPNN_PROBES`` /
 ``HPNN_NUMERICS`` / ``HPNN_LEDGER``), lifecycle spans + compiled-cost
-attribution (``HPNN_SPANS`` / ``HPNN_COST``), and a live export server whose
+attribution (``HPNN_SPANS`` / ``HPNN_COST``), the SLO tracker
+(``HPNN_SLO_MS`` — load shedding is additionally exercised to an
+actual Shed rejection in the serve section below), and a live export
+server whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
 minimal one.  A final ledger-only run proves the probes are
@@ -150,19 +153,22 @@ def check(tmpdir: str) -> list[str]:
     os.environ["HPNN_LEDGER"] = ledger_b
     os.environ["HPNN_SPANS"] = "1"
     os.environ["HPNN_COST"] = "1"
+    os.environ["HPNN_SLO_MS"] = "50"
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
     finally:
         for knob in ("HPNN_FLIGHT", "HPNN_PROBES", "HPNN_NUMERICS",
-                     "HPNN_LEDGER", "HPNN_SPANS", "HPNN_COST"):
+                     "HPNN_LEDGER", "HPNN_SPANS", "HPNN_COST",
+                     "HPNN_SLO_MS"):
             os.environ.pop(knob, None)
 
     if plain != instrumented:
         failures.append(
             "stdout is NOT byte-identical with HPNN_METRICS + "
             "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
-            "HPNN_SPANS + HPNN_COST + export server all enabled "
+            "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + export server all "
+            "enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
     body = scraped.get("metrics", "")
     if "# TYPE" not in body or "hpnn_" not in body:
@@ -200,6 +206,45 @@ def check(tmpdir: str) -> list[str]:
     sess.register_kernel("lint", k)
     sess.infer("lint", np.zeros(8))
     sess.close()
+
+    # SLO tracking + load shedding (HPNN_SLO_MS / HPNN_SHED_AGE_MS,
+    # obs/slo.py + serve/batcher.py) are serve-side features riding
+    # the same silence contract: arm both, serve a request, and force
+    # an actual Shed rejection on a fake-clock batcher — none of it
+    # may write a stdout byte even while the knobs are ON.
+    from hpnn_tpu import obs as obs_mod
+    from hpnn_tpu.serve import batcher as batcher_mod
+
+    os.environ["HPNN_SLO_MS"] = "50"
+    os.environ["HPNN_SHED_AGE_MS"] = "5"
+    obs_mod.slo._reset_for_tests()
+    shed_buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(shed_buf):
+            ssess = serve.Session(max_batch=8, n_buckets=2,
+                                  max_wait_ms=1.0)
+            ssess.register_kernel("lint_slo", k)
+            ssess.infer("lint_slo", np.zeros(8))
+            ssess.close()
+            fake = [0.0]
+            b = batcher_mod.Batcher(lambda p: p, clock=lambda: fake[0],
+                                    name="lint_shed", start=False)
+            b.submit(np.zeros((1, 8)))
+            fake[0] = 1.0  # oldest waiter now 1000ms > 5ms threshold
+            try:
+                b.submit(np.zeros((1, 8)))
+                raise RuntimeError("expected Shed")
+            except batcher_mod.Shed:
+                pass
+            b.close()
+    finally:
+        os.environ.pop("HPNN_SLO_MS", None)
+        os.environ.pop("HPNN_SHED_AGE_MS", None)
+        obs_mod.slo._reset_for_tests()
+    if shed_buf.getvalue():
+        failures.append(
+            "SLO tracking / load shedding wrote stdout: "
+            f"{shed_buf.getvalue()[:120]!r}")
 
     fsess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0,
                           fleet=True)

@@ -1,0 +1,532 @@
+#!/usr/bin/env python3
+"""Open/closed-loop HTTP traffic generator for the serving stack.
+
+Where bench_serve.py measures the in-process Session under a closed
+loop (a client's next request starts when its previous one returns —
+arrival rate adapts to service rate, so saturation hides), this tool
+drives the HTTP front end (serve/server.py) the way production
+traffic does:
+
+* **open loop** — requests fire at scheduled arrival times regardless
+  of completions: a Poisson process (``--process poisson``) or an
+  on/off burst process (``--process burst``, Poisson-within-bursts
+  scaled so the long-run average equals ``--rate``).  A bounded
+  worker pool issues them (concurrency caps at ``--workers``, making
+  this formally a partly-open loop);
+* **closed loop** — ``--closed``: N clients in sequential loops for
+  the duration (the saturation probe).
+
+Request mix: kernel names (``--kernels a,b``, fleet-mode servers
+coalesce same-topology kernels transparently) and row counts
+(``--rows 1,2,4``) are drawn per request.  429 responses are retried
+honoring ``Retry-After`` (capped; ``--retries 0`` records the shed
+instead), 504/timeouts are terminal per request.  The server's
+``X-Request-Id`` is recorded per outcome, so any row in the JSONL
+(``--out``) cross-correlates with the span sink via
+``tools/obs_report.py --spans --req <id>``.
+
+Outcome rows: ``{"t", "kernel", "rows", "status": ok|shed|timeout|
+error, "code", "latency_ms", "req_id", "attempts"}``; the summary
+(ONE JSON line on stdout, the bench.py convention) reports
+p50/p99/p99.9 of *served* latencies, goodput vs offered load, and
+shed/timeout rates.  :func:`run_bench_load` is the self-contained
+bench.py fold-in: measure saturation closed-loop, then offer 2x that
+open-loop against an SLO-armed, shedding server and report whether
+goodput held and the windowed p99 stayed within the objective
+(docs/observability.md "SLOs and load").
+
+    JAX_PLATFORMS=cpu python tools/loadgen.py --bench
+    python tools/loadgen.py --url http://127.0.0.1:8000 \
+        --rate 200 --duration 10 --process burst --out run.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import queue
+import socket
+import sys
+import threading
+import time
+import urllib.parse
+
+import numpy as np
+
+# ------------------------------------------------------------ summaries
+
+
+def percentile_ms(lat_s: list[float], q: float) -> float:
+    """Percentile ``q`` (in percent) of latencies given in seconds,
+    answered in milliseconds — the shared definition for bench_serve
+    and loadgen (linear interpolation, numpy default)."""
+    return round(float(np.percentile(np.asarray(lat_s) * 1e3, q)), 3)
+
+
+def latency_summary(lat_s: list[float]) -> dict:
+    """p50/p99/p99.9/mean/max (ms) of latencies in seconds; None-
+    filled when there were no served requests."""
+    if not lat_s:
+        return {"p50": None, "p99": None, "p999": None,
+                "mean": None, "max": None}
+    return {
+        "p50": percentile_ms(lat_s, 50),
+        "p99": percentile_ms(lat_s, 99),
+        "p999": percentile_ms(lat_s, 99.9),
+        "mean": round(float(np.mean(lat_s)) * 1e3, 3),
+        "max": round(float(np.max(lat_s)) * 1e3, 3),
+    }
+
+
+def summarize(records: list[dict], duration_s: float, *,
+              offered_rps: float | None = None) -> dict:
+    """Aggregate one run's outcome rows: counts per status, goodput
+    (served requests per second) vs offered load, shed/timeout rates,
+    and the latency summary of *served* requests only."""
+    n = len(records)
+    counts = {s: 0 for s in ("ok", "shed", "timeout", "error")}
+    for r in records:
+        counts[r["status"]] = counts.get(r["status"], 0) + 1
+    ok_lat_s = [r["latency_ms"] / 1e3 for r in records
+                if r["status"] == "ok"]
+    goodput = counts["ok"] / duration_s if duration_s else 0.0
+    if offered_rps is None:
+        offered_rps = n / duration_s if duration_s else 0.0
+    return {
+        "requests": n,
+        "duration_s": round(duration_s, 3),
+        "offered_rps": round(offered_rps, 1),
+        "ok": counts["ok"],
+        "shed": counts["shed"],
+        "timeout": counts["timeout"],
+        "error": counts["error"],
+        "goodput_rps": round(goodput, 1),
+        "goodput_vs_offered": (round(goodput / offered_rps, 4)
+                               if offered_rps else None),
+        "shed_rate": round(counts["shed"] / n, 4) if n else 0.0,
+        "timeout_rate": round(counts["timeout"] / n, 4) if n else 0.0,
+        "latency_ms": latency_summary(ok_lat_s),
+    }
+
+
+def write_jsonl(path: str, records: list[dict], summary: dict) -> None:
+    """One row per request outcome, then the summary as a final
+    ``{"summary": ...}`` row."""
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+        f.write(json.dumps({"summary": summary}) + "\n")
+
+
+# ------------------------------------------------------------ arrivals
+
+
+def poisson_arrivals(rate_rps: float, duration_s: float,
+                     rng: np.random.RandomState) -> list[float]:
+    """Arrival offsets (seconds) of a homogeneous Poisson process."""
+    if rate_rps <= 0:
+        return []
+    out, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rate_rps))
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def burst_arrivals(rate_rps: float, duration_s: float,
+                   rng: np.random.RandomState, *,
+                   on_s: float = 0.5, off_s: float = 0.5) -> list[float]:
+    """On/off burst process: Poisson arrivals during ``on_s`` phases,
+    silence during ``off_s`` phases, with the on-phase rate scaled so
+    the long-run average still equals ``rate_rps``."""
+    if rate_rps <= 0:
+        return []
+    burst_rate = rate_rps * (on_s + off_s) / on_s
+    out, t = [], 0.0
+    while t < duration_s:
+        end_on, tt = min(t + on_s, duration_s), t
+        while True:
+            tt += float(rng.exponential(1.0 / burst_rate))
+            if tt >= end_on:
+                break
+            out.append(tt)
+        t += on_s + off_s
+    return out
+
+
+def make_arrivals(process: str, rate_rps: float, duration_s: float,
+                  rng: np.random.RandomState) -> list[float]:
+    if process == "poisson":
+        return poisson_arrivals(rate_rps, duration_s, rng)
+    if process == "burst":
+        return burst_arrivals(rate_rps, duration_s, rng)
+    raise ValueError(f"unknown arrival process {process!r}")
+
+
+# ------------------------------------------------------------ client
+
+
+class _Client:
+    """One keep-alive HTTP connection with reconnect-on-disconnect
+    and the per-request retry policy (429 + ``Retry-After``)."""
+
+    def __init__(self, url: str, timeout_s: float):
+        u = urllib.parse.urlparse(
+            url if "//" in url else "http://" + url)
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.timeout_s = float(timeout_s)
+        self._conn: http.client.HTTPConnection | None = None
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+    def _post(self, path: str, body: bytes):
+        # one silent reconnect: a keep-alive peer may have gone away
+        for attempt in (0, 1):
+            try:
+                if self._conn is None:
+                    self._conn = http.client.HTTPConnection(
+                        self.host, self.port,
+                        timeout=self.timeout_s + 1.0)
+                    self._conn.connect()
+                    # measurement hygiene: without TCP_NODELAY a
+                    # Nagle/delayed-ACK stall adds ~40 ms to loopback
+                    # latencies and caps the generator's offered rate
+                    self._conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conn.request(
+                    "POST", path, body=body,
+                    headers={"Content-Type": "application/json"})
+                resp = self._conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.getheaders()), data
+            except socket.timeout:
+                self.close()
+                raise
+            except (http.client.HTTPException, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise OSError("unreachable")
+
+    def request(self, kernel: str, rows: int, body: bytes, *,
+                max_retries: int = 2,
+                retry_cap_s: float = 1.0) -> dict:
+        """Issue one logical request (with 429 retries); returns its
+        outcome row (latency spans all attempts, sleeps included)."""
+        attempts, code, req_id, status = 0, None, None, "error"
+        t_start = time.perf_counter()
+        while True:
+            attempts += 1
+            try:
+                code, headers, _data = self._post("/v1/infer", body)
+            except socket.timeout:
+                status, code = "timeout", None
+                break
+            except (http.client.HTTPException, OSError):
+                status, code = "error", None
+                break
+            req_id = headers.get("X-Request-Id") or req_id
+            if code == 200:
+                status = "ok"
+                break
+            if code == 429:
+                if attempts > max_retries:
+                    status = "shed"
+                    break
+                retry_s = 1.0
+                try:
+                    retry_s = float(headers.get("Retry-After", "1"))
+                except ValueError:
+                    pass
+                time.sleep(min(max(retry_s, 0.0), retry_cap_s))
+                continue
+            status = "timeout" if code == 504 else "error"
+            break
+        return {
+            "kernel": kernel,
+            "rows": rows,
+            "status": status,
+            "code": code,
+            "latency_ms": round(
+                (time.perf_counter() - t_start) * 1e3, 3),
+            "req_id": req_id,
+            "attempts": attempts,
+        }
+
+
+def _request_bodies(kernels, rows_choices, n_in: int,
+                    timeout_s: float) -> dict:
+    """Pre-serialized request bodies per (kernel, rows): payload
+    values are irrelevant to load, so encode each combination once."""
+    bodies = {}
+    for k in kernels:
+        for r in rows_choices:
+            inputs = [[0.1] * int(n_in)] * int(r)
+            bodies[(k, r)] = json.dumps(
+                {"kernel": k, "inputs": inputs,
+                 "timeout_s": timeout_s}).encode()
+    return bodies
+
+
+# ------------------------------------------------------------ runners
+
+
+def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
+                  process: str = "poisson",
+                  kernels=("default",), rows_choices=(1,),
+                  n_in: int = 8, timeout_s: float = 2.0,
+                  max_retries: int = 2, retry_cap_s: float = 1.0,
+                  n_workers: int = 16, seed: int = 0,
+                  out_path: str | None = None) -> dict:
+    """Offered-load run: arrivals are scheduled up front and fired on
+    time by a worker pool whether or not earlier requests finished.
+    Returns the summary dict (and writes the JSONL to ``out_path``)."""
+    rng = np.random.RandomState(seed)
+    arrivals = make_arrivals(process, rate_rps, duration_s, rng)
+    bodies = _request_bodies(kernels, rows_choices, n_in, timeout_s)
+    specs: "queue.Queue[tuple]" = queue.Queue()
+    for t in arrivals:
+        k = kernels[int(rng.randint(len(kernels)))]
+        r = int(rows_choices[int(rng.randint(len(rows_choices)))])
+        specs.put((t, k, r))
+    records: list[dict] = []
+    rec_lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def worker():
+        client = _Client(url, timeout_s)
+        try:
+            while True:
+                try:
+                    t_due, k, r = specs.get_nowait()
+                except queue.Empty:
+                    return
+                delay = t0 + t_due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                rec = client.request(k, r, bodies[(k, r)],
+                                     max_retries=max_retries,
+                                     retry_cap_s=retry_cap_s)
+                rec["t"] = round(t_due, 6)
+                with rec_lock:
+                    records.append(rec)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, int(n_workers)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = max(time.perf_counter() - t0, duration_s)
+    summary = summarize(records, wall_s, offered_rps=rate_rps)
+    summary["process"] = process
+    if out_path:
+        write_jsonl(out_path, records, summary)
+    return summary
+
+
+def run_closed_loop(url: str, *, n_clients: int = 4,
+                    duration_s: float = 2.0,
+                    kernels=("default",), rows_choices=(1,),
+                    n_in: int = 8, timeout_s: float = 2.0,
+                    max_retries: int = 0, retry_cap_s: float = 1.0,
+                    seed: int = 0,
+                    out_path: str | None = None) -> dict:
+    """Saturation probe: N clients in sequential request loops for the
+    duration.  Offered load equals achieved load by construction."""
+    records: list[dict] = []
+    rec_lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def client_loop(ci: int):
+        rng = np.random.RandomState(seed + 1000 + ci)
+        client = _Client(url, timeout_s)
+        bodies = _request_bodies(kernels, rows_choices, n_in,
+                                 timeout_s)
+        try:
+            while time.perf_counter() - t0 < duration_s:
+                k = kernels[int(rng.randint(len(kernels)))]
+                r = int(rows_choices[int(
+                    rng.randint(len(rows_choices)))])
+                rec = client.request(k, r, bodies[(k, r)],
+                                     max_retries=max_retries,
+                                     retry_cap_s=retry_cap_s)
+                rec["t"] = round(time.perf_counter() - t0, 6)
+                with rec_lock:
+                    records.append(rec)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=client_loop, args=(ci,),
+                                daemon=True)
+               for ci in range(max(1, int(n_clients)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    summary = summarize(records, wall_s)
+    summary["n_clients"] = int(n_clients)
+    if out_path:
+        write_jsonl(out_path, records, summary)
+    return summary
+
+
+# ------------------------------------------------------------ bench
+
+
+def run_bench_load(*, slo_ms: float = 50.0, seed: int = 7,
+                   saturation_s: float = 1.5,
+                   load_s: float = 3.0) -> dict:
+    """The bench.py fold-in: stand up an in-process SLO-armed server
+    over a tiny kernel, measure saturation closed-loop, then offer 2x
+    that open-loop and report whether shedding held goodput near the
+    plateau and the server-side windowed p99 of accepted requests
+    within the objective."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from hpnn_tpu import obs, serve
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve.server import make_server
+
+    env_keys = (obs.slo.ENV_KNOB, obs.slo.ENV_WINDOW,
+                obs.slo.ENV_TARGET)
+    prev_env = {k: os.environ.get(k) for k in env_keys}
+    obs.slo.configure(slo_ms, window_s=max(30.0, load_s * 4))
+    session = None
+    server = None
+    try:
+        k, _ = kernel_mod.generate(seed, 8, [5], 2)
+        session = serve.Session(
+            max_batch=16, n_buckets=3, max_wait_ms=1.0, max_depth=64,
+            shed_age_ms=max(1.0, slo_ms / 4.0))
+        session.register_kernel("bench", k)
+        server = make_server(session, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        common = dict(kernels=("bench",), rows_choices=(1, 2, 4),
+                      n_in=8, timeout_s=2.0, max_retries=0)
+        # discarded warmup: the first requests pay eager-path tracing
+        # and would depress the saturation estimate
+        run_closed_loop(url, n_clients=2, duration_s=0.3, seed=seed,
+                        **common)
+        sat = run_closed_loop(url, n_clients=8,
+                              duration_s=saturation_s, seed=seed,
+                              **common)
+        sat_rps = sat["goodput_rps"]
+        offered = max(10.0, 2.0 * sat_rps)
+        load = run_open_loop(url, rate_rps=offered,
+                             duration_s=load_s, process="poisson",
+                             n_workers=16, seed=seed + 1, **common)
+        slo_doc = obs.slo.health_doc()
+        vs_sat = (load["goodput_rps"] / sat_rps if sat_rps else None)
+        return {
+            "metric": "serve_load",
+            "slo_ms": float(slo_ms),
+            "saturation_rps": sat_rps,
+            "offered_rps": load["offered_rps"],
+            "goodput_rps": load["goodput_rps"],
+            "goodput_vs_saturation": (None if vs_sat is None
+                                      else round(vs_sat, 4)),
+            "p99_under_load_ms": slo_doc.get("p99_ms"),
+            "slo_attainment": slo_doc.get("attainment"),
+            "saturation": sat,
+            "load": load,
+            "slo": slo_doc,
+        }
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if session is not None:
+            session.close()
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        obs.slo._reset_for_tests()
+
+
+# ------------------------------------------------------------ main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open/closed-loop load generator for the HTTP "
+                    "serving front end")
+    ap.add_argument("--url", help="server base url "
+                                  "(e.g. http://127.0.0.1:8000)")
+    ap.add_argument("--bench", action="store_true",
+                    help="self-contained in-process bench "
+                         "(saturation probe + 2x open-loop)")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="offered load, requests/s (open loop)")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--process", choices=("poisson", "burst"),
+                    default="poisson")
+    ap.add_argument("--closed", action="store_true",
+                    help="closed loop (saturation probe) instead of "
+                         "offered load")
+    ap.add_argument("--clients", type=int, default=4,
+                    help="closed-loop client count")
+    ap.add_argument("--workers", type=int, default=16,
+                    help="open-loop worker pool size")
+    ap.add_argument("--kernels", default="default",
+                    help="comma-separated kernel names")
+    ap.add_argument("--rows", default="1",
+                    help="comma-separated row counts to mix")
+    ap.add_argument("--n-in", type=int, default=8,
+                    help="input width of the target kernels")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-request timeout_s")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="max 429 retries per request (0: record "
+                         "the shed)")
+    ap.add_argument("--retry-cap", type=float, default=1.0,
+                    help="cap on honored Retry-After sleeps")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", help="write per-request JSONL here")
+    args = ap.parse_args(argv)
+
+    if args.bench:
+        out = run_bench_load(seed=args.seed or 7)
+        print(json.dumps(out))
+        return 0
+    if not args.url:
+        ap.error("--url is required (or use --bench)")
+    kernels = tuple(s for s in args.kernels.split(",") if s)
+    rows = tuple(int(s) for s in args.rows.split(",") if s)
+    common = dict(kernels=kernels, rows_choices=rows,
+                  n_in=args.n_in, timeout_s=args.timeout,
+                  max_retries=args.retries,
+                  retry_cap_s=args.retry_cap, seed=args.seed,
+                  out_path=args.out)
+    if args.closed:
+        summary = run_closed_loop(args.url, n_clients=args.clients,
+                                  duration_s=args.duration, **common)
+    else:
+        summary = run_open_loop(args.url, rate_rps=args.rate,
+                                duration_s=args.duration,
+                                process=args.process,
+                                n_workers=args.workers, **common)
+    print(json.dumps(summary))
+    return 0 if summary["requests"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -197,6 +197,34 @@ def collect_spans(events: list[dict]) -> list[dict]:
     return spans
 
 
+def filter_spans_req(spans: list[dict], req_id: str) -> list[dict]:
+    """Keep only the spans belonging to one request: every span whose
+    fields carry ``req_id == <id>`` (the edge-minted id the HTTP layer
+    threads through ``serve.request``/``serve.queue``), plus their
+    ancestors and descendants — so ``--req`` reconstructs the full
+    queue/dispatch breakdown of a single request from a busy sink."""
+    by_id = {s["span"]: s for s in spans if s["span"] is not None}
+    keep: set = set()
+    for s in spans:
+        if s["fields"].get("req_id") != req_id:
+            continue
+        cur = s
+        while cur is not None and cur["span"] not in keep:
+            keep.add(cur["span"])
+            cur = by_id.get(cur["parent"])
+    changed = True
+    while changed:
+        changed = False
+        for s in spans:
+            if s["span"] in keep:
+                continue
+            parent = by_id.get(s["parent"])
+            if parent is not None and parent["span"] in keep:
+                keep.add(s["span"])
+                changed = True
+    return [s for s in spans if s["span"] in keep]
+
+
 def span_tree(spans: list[dict]) -> list[dict]:
     """Arrange spans into root trees (children nested under parents).
 
@@ -236,20 +264,29 @@ def _render_span_node(w, node: dict, depth: int) -> None:
         _render_span_node(w, child, depth + 1)
 
 
-def render_spans(events: list[dict], top: int = 10) -> str:
+def render_spans(events: list[dict], top: int = 10,
+                 req_id: str | None = None) -> str:
     """The --spans report: latency-breakdown tree + slowest-N table.
 
     The tree nests each span under its parent so queue wait
     (``serve.queue``) reads separately from device time
     (``serve.dispatch``) inside one ``serve.request``, and each parent
-    shows its children-sum vs. self time.
+    shows its children-sum vs. self time.  ``req_id`` narrows the
+    report to one request's spans (--req).
     """
     spans = collect_spans(events)
+    if req_id is not None:
+        spans = filter_spans_req(spans, req_id)
     out: list[str] = []
     w = out.append
     w("== span report ==")
+    if req_id is not None:
+        w(f"req_id: {req_id}")
     if not spans:
-        w("  (no span.end records — was HPNN_SPANS set?)")
+        if req_id is not None:
+            w(f"  (no spans carry req_id={req_id!r})")
+        else:
+            w("  (no span.end records — was HPNN_SPANS set?)")
         return "\n".join(out) + "\n"
     w(f"spans: {len(spans)}")
     w("")
@@ -385,6 +422,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--top", type=int, default=10, metavar="N",
                     help="with --spans: rows in the slowest table "
                          "(default 10)")
+    ap.add_argument("--req", metavar="ID",
+                    help="with --spans: only the spans of one request "
+                         "(the X-Request-Id the serve layer minted; "
+                         "ancestors/descendants included)")
     ap.add_argument("--merge", action="store_true",
                     help="join several {rank}-expanded sinks into one "
                          "cross-rank timeline (skew-tolerant ordering)")
@@ -406,13 +447,19 @@ def main(argv: list[str] | None = None) -> int:
     except OSError as exc:
         sys.stderr.write(f"obs_report: {exc}\n")
         return 1
+    if args.req and not args.spans:
+        sys.stderr.write("obs_report: --req needs --spans\n")
+        return 2
     if args.spans:
         if args.json:
-            json.dump(collect_spans(events), sys.stdout, indent=2,
-                      default=str)
+            spans = collect_spans(events)
+            if args.req:
+                spans = filter_spans_req(spans, args.req)
+            json.dump(spans, sys.stdout, indent=2, default=str)
             sys.stdout.write("\n")
         else:
-            sys.stdout.write(render_spans(events, top=args.top))
+            sys.stdout.write(render_spans(events, top=args.top,
+                                          req_id=args.req))
         return 0
     rep = summarize(events)
     if args.merge:
