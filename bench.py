@@ -823,6 +823,20 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["load_error"] = repr(exc)
 
+    # Train-while-serve (tools/bench_online.py): idle-serve goodput
+    # plateau vs goodput with the background trainer promoting
+    # candidates in-process, plus promotion latency (docs/online.md).
+    # Best-effort; HPNN_BENCH_NO_ONLINE=1 skips it.
+    if not os.environ.get("HPNN_BENCH_NO_ONLINE"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import bench_online
+
+            out["online"] = bench_online.run_bench_online()
+        except Exception as exc:
+            out["online_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -885,6 +899,14 @@ def main(argv=None) -> None:
         compact["load_p99_ms"] = ld["p99_under_load_ms"]
         compact["load_goodput_vs_saturation"] = (
             ld["goodput_vs_saturation"])
+    if "online" in out:
+        on = out["online"]
+        compact["online_goodput_rps"] = on["online_goodput_rps"]
+        compact["online_goodput_vs_idle"] = (
+            on["online_goodput_vs_idle"])
+        compact["online_promotions"] = on["promotions"]
+        compact["online_promote_latency_ms"] = (
+            on["promote_latency_ms"])
     if "obs_overhead" in out:
         compact["obs_overhead_pct"] = (
             out["obs_overhead"]["paired_overhead_pct"]["median"]

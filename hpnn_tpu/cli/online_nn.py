@@ -1,0 +1,133 @@
+"""``online_nn`` — train-while-serve a conf's kernel in one resident
+process.
+
+The fourth driver: where ``serve_nn`` keeps a frozen kernel resident,
+``online_nn`` keeps it *learning* — the HTTP front end gains
+``POST /ingest``, a background trainer snapshots the stream buffer
+every ``--interval-s``, and sentinel-clean candidates that beat the
+resident on the held-out eval are promoted atomically
+(docs/online.md).  ``--stream mnist|xrd`` pre-feeds N synthetic
+samples so the demo loop promotes without an external feeder.
+
+    online_nn [-v] [--port N] [--host H] [--metrics PATH]
+              [--interval-s F] [--rows N] [--batch N] [--epochs N]
+              [--margin F] [--stream mnist|xrd] [--stream-n N]
+              nn.conf
+
+stdout stays silent (token protocol); diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from hpnn_tpu import config, runtime
+from hpnn_tpu.cli import common
+
+_MODEL_OF = {"ANN": "ann", "SNN": "snn"}
+
+
+def build_from_conf(conf, *, host: str = "127.0.0.1", port: int = 0,
+                    interval_s: float | None = None,
+                    rows: int | None = None, batch: int | None = None,
+                    epochs: int | None = None,
+                    margin: float | None = None,
+                    stream: str | None = None, stream_n: int = 256,
+                    seed: int = 0):
+    """(online_session, server) for ``conf``'s kernel — the testable
+    core of ``main``.  ``stream`` pre-feeds the buffer from a demo
+    stream driver (the kernel widths must match the stream's)."""
+    from hpnn_tpu import online, serve
+    from hpnn_tpu.online import streams
+
+    if conf.kernel is None:
+        raise ValueError("conf has no kernel (missing [init] line?)")
+    model = _MODEL_OF.get(conf.type.name)
+    if model is None:
+        raise ValueError(f"cannot serve kernel type {conf.type.name}")
+    gate = online.Gate(margin=margin) if margin is not None else None
+    osess = online.OnlineSession(
+        interval_s=interval_s, rows=rows, batch=batch, epochs=epochs,
+        gate=gate, seed=seed)
+    name = conf.name or "default"
+    osess.add_kernel(name, conf.kernel, model=model)
+    if stream:
+        makers = {"mnist": streams.mnist_stream,
+                  "xrd": streams.xrd_stream}
+        maker = makers.get(stream)
+        if maker is None:
+            raise ValueError(f"unknown stream {stream!r} "
+                             "(want mnist|xrd)")
+        X, T = streams.take(maker(seed), stream_n)
+        if X.shape[1] != conf.kernel.n_inputs:
+            raise ValueError(
+                f"stream {stream!r} feeds {X.shape[1]} inputs but the "
+                f"kernel takes {conf.kernel.n_inputs}")
+        osess.feed(X, T)
+    server = serve.make_server(osess.serve, host=host, port=port)
+    return osess, server
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    common.install_sigpipe_handler()
+    runtime.init_all(1)
+    argv, opts = common.extract_long_opts(
+        argv,
+        valued=("port", "host", "metrics", "interval-s", "rows",
+                "batch", "epochs", "margin", "stream", "stream-n"),
+    )
+    if argv is None or not common.validate_long_opts(opts):
+        runtime.deinit_all()
+        return -1
+    if "metrics" in opts:
+        from hpnn_tpu import obs
+
+        obs.configure(opts["metrics"])
+    filename = common.parse_args(argv, "online_nn")
+    if filename is None:
+        runtime.deinit_all()
+        return 0
+    conf = config.load_conf(filename)
+    if conf is None:
+        sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    try:
+        osess, server = build_from_conf(
+            conf,
+            host=opts.get("host", "127.0.0.1"),
+            port=int(opts.get("port", 8700)),
+            interval_s=(float(opts["interval-s"])
+                        if "interval-s" in opts else None),
+            rows=int(opts["rows"]) if "rows" in opts else None,
+            batch=int(opts["batch"]) if "batch" in opts else None,
+            epochs=int(opts["epochs"]) if "epochs" in opts else None,
+            margin=(float(opts["margin"]) if "margin" in opts
+                    else None),
+            stream=opts.get("stream"),
+            stream_n=int(opts.get("stream-n", 256)),
+        )
+    except (ValueError, OSError) as exc:
+        sys.stderr.write(f"online_nn: cannot start: {exc}\n")
+        runtime.deinit_all()
+        return -1
+    host, port = server.server_address[:2]
+    sys.stderr.write(
+        f"online_nn: kernel {osess.kernels()[0]!r} resident and "
+        f"learning (window {osess.trainer.rows}, every "
+        f"{osess.trainer.interval_s}s), listening on {host}:{port}\n")
+    osess.start()
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        osess.close()
+        runtime.deinit_all()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,40 @@
+"""Train-while-serve: continuous online learning in one resident
+process (ROADMAP item 3; PAPER.md §0's "trained on the fly" story).
+
+The pieces, each its own module so the serve stack stays importable
+without jax:
+
+* :mod:`~hpnn_tpu.online.ingest` — :class:`SampleBuffer`, the bounded
+  streaming sample store (ring + optional reservoir replay + held-out
+  eval diversion), fed by ``OnlineSession.feed()`` and the serve
+  server's ``POST /ingest`` route.
+* :mod:`~hpnn_tpu.online.trainer` — :class:`OnlineTrainer`, the
+  background thread that snapshots the buffer and trains candidate
+  weights on the scan-ordered bank (``train/fleet.py``), one fleet
+  dispatch when several same-topology kernels ride the same stream.
+* :mod:`~hpnn_tpu.online.promote` — the sentinel + eval gate and the
+  atomic in-memory promotion (``Registry.install``) with rollback.
+* :mod:`~hpnn_tpu.online.session` — :class:`OnlineSession`, the
+  facade wiring all of it onto a ``serve.Session``.
+* :mod:`~hpnn_tpu.online.streams` — the demo stream drivers
+  (MNIST-stream, synthetic XRD-stream).
+
+Knobs (``HPNN_ONLINE_*``) are read once at construction time and
+nothing outside this package touches them — an unset knob costs
+nothing anywhere (proved in ``tools/check_tokens.py``).  Catalog and
+architecture: docs/online.md.
+"""
+
+from hpnn_tpu.online.ingest import SampleBuffer
+from hpnn_tpu.online.promote import Gate, Promoter, eval_loss
+from hpnn_tpu.online.session import OnlineSession
+from hpnn_tpu.online.trainer import OnlineTrainer
+
+__all__ = [
+    "SampleBuffer",
+    "Gate",
+    "Promoter",
+    "eval_loss",
+    "OnlineSession",
+    "OnlineTrainer",
+]

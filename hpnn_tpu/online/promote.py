@@ -1,0 +1,234 @@
+"""Gated atomic promotion of online-trained candidates.
+
+A candidate earns residency only by passing BOTH gates:
+
+1. **sentinel-clean** — a host finiteness sweep plus, when a numerics
+   knob is armed, the full :func:`obs.probes.check_weights` flow
+   (checksum event, ledger row, NaN tripwire, divergence sentinel).
+   Under ``HPNN_NUMERICS=abort`` a dirty candidate raises
+   ``NumericsError`` *inside the gate*; the gate converts that to a
+   rejection — a poisoned candidate must never take down the resident
+   serving process.
+2. **held-out eval margin** — the candidate's loss on the held-out
+   eval set must beat the resident version's by ``Gate.margin``
+   (relative): ``cand < resident * (1 - margin)``.
+
+Promotion is the serve registry's in-memory ``install`` path: a new
+immutable ``Entry`` with a bumped version, engine warmed on the new
+version and old executables evicted — no disk round-trip, and
+in-flight batches finish on the entry they dispatched with (never a
+torn read).  The prior entry is retained for rollback: a post-
+promotion SLO breach or serve-side numerics regression inside the
+``Gate.watch_s`` window re-installs the prior weights *object*, so
+answers are bitwise-identical to the pre-promotion version (the
+parity-mode closure maths over the exact same host arrays).
+
+Events: ``online.promote`` / ``online.reject`` / ``online.rollback``;
+gauges ``online.candidate_loss`` / ``online.resident_loss`` /
+``online.promote_latency_ms``.  Catalog: docs/online.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from hpnn_tpu import obs
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.online.ingest import _env_float
+from hpnn_tpu.obs.probes import NumericsError
+
+REJECT_SENTINEL = "sentinel"
+REJECT_MARGIN = "margin"
+REJECT_EVAL = "eval"
+
+
+class Gate:
+    """Promotion-gate policy.  ``margin`` is the required *relative*
+    eval improvement (``HPNN_ONLINE_MARGIN``, default 0.01);
+    ``watch_s`` the post-promotion regression-watch window
+    (``HPNN_ONLINE_WATCH_S``, default 30); ``min_eval_rows`` the
+    smallest held-out set a promotion may be justified by."""
+
+    def __init__(self, *, margin: float | None = None,
+                 watch_s: float | None = None, min_eval_rows: int = 4):
+        self.margin = float(margin if margin is not None
+                            else _env_float("HPNN_ONLINE_MARGIN", 0.01))
+        self.watch_s = float(watch_s if watch_s is not None
+                             else _env_float("HPNN_ONLINE_WATCH_S", 30.0))
+        self.min_eval_rows = int(min_eval_rows)
+
+
+# one jitted eval per (model, topology, eval-set shape): candidate and
+# resident share it, so the margin comparison is apples-to-apples
+_EVAL_FNS: dict = {}
+_EVAL_LOCK = threading.Lock()
+
+
+def eval_loss(weights, X, T, *, model: str = "ann") -> float:
+    """Mean per-sample training error of ``weights`` over the eval
+    block — the gate's scoring function (one jit per topology/shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    if model == "snn":
+        from hpnn_tpu.models import snn as mod
+    else:
+        from hpnn_tpu.models import ann as mod
+    key = (model,
+           tuple(tuple(int(d) for d in w.shape) for w in weights),
+           int(np.asarray(X).shape[0]))
+    with _EVAL_LOCK:
+        fn = _EVAL_FNS.get(key)
+    if fn is None:
+        def _loss(ws, Xb, Tb):
+            outs = jax.vmap(lambda x: mod.run(ws, x))(Xb)
+            return jnp.mean(jax.vmap(mod.train_error)(outs, Tb))
+
+        fn = jax.jit(_loss)
+        with _EVAL_LOCK:
+            fn = _EVAL_FNS.setdefault(key, fn)
+    ws = tuple(jnp.asarray(w) for w in weights)
+    return float(fn(ws, jnp.asarray(X), jnp.asarray(T)))
+
+
+class Promoter:
+    """Per-kernel promotion state over one ``serve.Session``: the
+    gate, the prior-entry store for rollback, and the post-promotion
+    regression watch."""
+
+    def __init__(self, session, *, gate: Gate | None = None,
+                 clock=time.monotonic):
+        self.session = session
+        self.gate = gate or Gate()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._prior: dict[str, object] = {}    # name -> prior Entry
+        self._watch: dict[str, dict] = {}      # name -> armed watch
+        self.stats = {"promoted": 0, "rejected": 0, "rollbacks": 0}
+        self.last_promote_latency_s: float | None = None
+        self.last_losses: dict[str, tuple] = {}
+
+    # ----------------------------------------------------------- verdict
+    def _reject(self, name: str, reason: str, **fields) -> str:
+        obs.event("online.reject", kernel=name, reason=reason, **fields)
+        with self._lock:
+            self.stats["rejected"] += 1
+        return reason
+
+    def consider(self, name: str, candidate_weights, eval_set, *,
+                 step: int) -> str:
+        """Run the full gate over one candidate; returns "promoted"
+        or the rejection reason ("sentinel" | "margin" | "eval")."""
+        ws = tuple(np.asarray(w) for w in candidate_weights)
+        # host finiteness sweep: always on — the gate itself must not
+        # depend on any obs knob being armed
+        if not all(np.isfinite(w).all() for w in ws):
+            return self._reject(name, REJECT_SENTINEL, step=step)
+        # full sentinel flow (ledger row, divergence, tripwire) when a
+        # numerics knob is armed; an abort-mode trip is *handled* here
+        try:
+            verdict = obs.probes.check_weights(
+                ws, step=step, where="online_gate")
+        except NumericsError:
+            return self._reject(name, REJECT_SENTINEL, step=step,
+                                mode="abort")
+        if verdict is not None and (not verdict["clean"]
+                                    or verdict["divergent"]):
+            return self._reject(name, REJECT_SENTINEL, step=step)
+
+        if eval_set is None:
+            return self._reject(name, REJECT_EVAL, step=step,
+                                detail="no held-out eval data")
+        X, T = eval_set
+        if np.asarray(X).shape[0] < self.gate.min_eval_rows:
+            return self._reject(name, REJECT_EVAL, step=step,
+                                detail="held-out eval set too small")
+        resident = self.session.registry.get(name)
+        cand_loss = eval_loss(ws, X, T, model=resident.model)
+        res_loss = eval_loss(resident.kernel.weights, X, T,
+                             model=resident.model)
+        obs.gauge("online.candidate_loss", cand_loss, kernel=name)
+        obs.gauge("online.resident_loss", res_loss, kernel=name)
+        self.last_losses[name] = (cand_loss, res_loss)
+        if not np.isfinite(cand_loss):
+            return self._reject(name, REJECT_SENTINEL, step=step,
+                                detail="non-finite eval loss")
+        if not cand_loss < res_loss * (1.0 - self.gate.margin):
+            return self._reject(name, REJECT_MARGIN, step=step,
+                                cand_loss=cand_loss, res_loss=res_loss)
+
+        # both gates passed: atomic in-memory promotion
+        t0 = self._clock()
+        entry = self.session.install_kernel(
+            name, kernel_mod.Kernel(weights=ws))
+        dt = self._clock() - t0
+        with self._lock:
+            self._prior[name] = resident
+            self._watch[name] = {"armed_at": self._clock(),
+                                 "version": entry.version}
+            self.stats["promoted"] += 1
+            self.last_promote_latency_s = dt
+        obs.event("online.promote", kernel=name,
+                  from_version=resident.version,
+                  to_version=entry.version, cand_loss=cand_loss,
+                  res_loss=res_loss, install_s=round(dt, 6))
+        obs.gauge("online.promote_latency_ms", round(dt * 1e3, 3),
+                  kernel=name)
+        return "promoted"
+
+    # ---------------------------------------------------------- rollback
+    def rollback(self, name: str, *, reason: str = "manual"):
+        """Re-install the pre-promotion entry's weights (bitwise — the
+        same host arrays) as a new version; returns the new Entry, or
+        None when there is nothing to roll back to."""
+        with self._lock:
+            prior = self._prior.pop(name, None)
+            self._watch.pop(name, None)
+        if prior is None:
+            return None
+        current = self.session.registry.get(name)
+        entry = self.session.install_kernel(name, prior.kernel)
+        with self._lock:
+            self.stats["rollbacks"] += 1
+        obs.event("online.rollback", kernel=name,
+                  from_version=current.version,
+                  to_version=entry.version,
+                  restored=prior.version, reason=reason)
+        return entry
+
+    def watching(self, name: str) -> bool:
+        with self._lock:
+            return name in self._watch
+
+    def check_watch(self) -> list[str]:
+        """Post-promotion regression scan: inside each armed watch
+        window, a serve-side numerics regression (NaN outputs recorded
+        by ``probes.note_serve``) or an SLO breach rolls the kernel
+        back; a watch that survives its window disarms.  Returns the
+        kernels rolled back this call."""
+        now = self._clock()
+        with self._lock:
+            armed = list(self._watch.items())
+        rolled = []
+        for name, w in armed:
+            if now - w["armed_at"] > self.gate.watch_s:
+                with self._lock:
+                    self._watch.pop(name, None)
+                continue
+            reason = None
+            num = obs.probes.health_doc([name])
+            kdoc = num.get("kernels", {}).get(name)
+            if kdoc is not None and not kdoc.get("clean", True):
+                reason = "numerics"
+            if reason is None:
+                slo = obs.slo.health_doc()
+                if (slo.get("mode") == "on" and slo.get("served")
+                        and slo.get("verdict") == "breach"):
+                    reason = "slo"
+            if reason is not None and self.rollback(
+                    name, reason=reason) is not None:
+                rolled.append(name)
+        return rolled

@@ -1,0 +1,162 @@
+"""The train-while-serve facade: one resident process that serves,
+ingests, trains, and promotes.
+
+:class:`OnlineSession` wires a :class:`~hpnn_tpu.serve.server.Session`
+(owned or adopted) to the streaming buffer, the background trainer,
+and the promotion gate, and registers itself on the serve session so
+the HTTP front end grows two behaviors with zero new plumbing:
+
+* ``POST /ingest`` — the server's ingest route calls
+  ``session.ingest_hook`` (set here) to feed the buffer;
+* ``GET /healthz`` — the health document gains an ``online`` section
+  (buffer depth/staleness, rounds, promotions/rollbacks, per-kernel
+  versions + watch state) via ``session.online_health``.
+
+Typical use (the ``cli/online_nn.py`` driver does exactly this)::
+
+    osess = OnlineSession(eval_set=None, interval_s=0.5)
+    osess.add_kernel("mnist", kernel)
+    server = serve.make_server(osess.serve, port=8700)
+    osess.start()                       # background trainer
+    ...
+    osess.feed(x, t)                    # or POST /ingest
+    osess.infer("mnist", x)
+
+Tests drive the loop deterministically: ``start=False`` (default) and
+``tick()`` per round.  Knobs: docs/online.md.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from hpnn_tpu.online.ingest import SampleBuffer
+from hpnn_tpu.online.promote import Gate, Promoter
+from hpnn_tpu.online.trainer import OnlineTrainer
+
+
+class OnlineSession:
+    """Serve + ingest + train + promote behind one object.
+
+    ``session=None`` builds an owned ``serve.Session`` from
+    ``serve_kwargs`` (closed by :meth:`close`); pass an existing
+    session to adopt it (the caller keeps ownership).  All tracked
+    kernels learn from ONE shared stream — the ensemble-on-a-stream
+    shape; same-topology kernels train as one fleet dispatch."""
+
+    def __init__(self, *, session=None, serve_kwargs: dict | None = None,
+                 eval_set=None, gate: Gate | None = None,
+                 capacity: int | None = None,
+                 reservoir: int | None = None,
+                 holdout: int | None = None,
+                 rows: int | None = None, batch: int | None = None,
+                 epochs: int | None = None,
+                 interval_s: float | None = None,
+                 momentum: bool = False, replay_frac: float = 0.25,
+                 seed: int = 0, clock=time.monotonic,
+                 start: bool = False):
+        from hpnn_tpu import serve
+
+        self._own_serve = session is None
+        self.serve = session or serve.Session(**(serve_kwargs or {}))
+        self.buffer = SampleBuffer(capacity=capacity,
+                                   reservoir=reservoir,
+                                   holdout=holdout, clock=clock,
+                                   seed=seed)
+        self.promoter = Promoter(self.serve, gate=gate, clock=clock)
+        self.trainer = OnlineTrainer(
+            self.buffer, self.serve, self.promoter, rows=rows,
+            batch=batch, epochs=epochs, interval_s=interval_s,
+            momentum=momentum, replay_frac=replay_frac, seed=seed,
+            clock=clock)
+        if eval_set is not None:
+            X, T = eval_set
+            self.trainer.eval_set = (
+                np.asarray(X, dtype=np.float64),
+                np.asarray(T, dtype=np.float64))
+        # grow the HTTP front end: POST /ingest + /healthz "online"
+        self.serve.ingest_hook = self._ingest
+        self.serve.online_health = self.health_doc
+        if start:
+            self.trainer.start()
+
+    # ----------------------------------------------------------- kernels
+    def add_kernel(self, name: str, kernel, *, model: str = "ann",
+                   warmup: bool = True):
+        """Register ``kernel`` in the serve registry AND track it for
+        online training/promotion."""
+        entry = self.serve.register_kernel(name, kernel, model=model,
+                                           warmup=warmup)
+        self.trainer.track(name)
+        return entry
+
+    def kernels(self) -> list[str]:
+        return self.trainer.names()
+
+    # ------------------------------------------------------------ stream
+    def feed(self, x, t) -> int:
+        """Push sample(s) into the training stream."""
+        return self.buffer.feed(x, t)
+
+    def _ingest(self, kernel: str | None, X, T) -> dict:
+        """The serve server's ``POST /ingest`` hook.  ``kernel`` is
+        advisory (the stream is shared): when given it must name a
+        tracked kernel."""
+        if kernel is not None and kernel not in self.trainer.names():
+            raise KeyError(kernel)
+        accepted = self.buffer.feed(X, T)
+        return {"accepted": accepted, "depth": self.buffer.depth()}
+
+    # ------------------------------------------------------------- serve
+    def infer(self, name: str, x, **kwargs):
+        return self.serve.infer(name, x, **kwargs)
+
+    # ------------------------------------------------------------- train
+    def tick(self) -> dict:
+        """One synchronous trainer round (the deterministic test
+        path); returns the round summary."""
+        return self.trainer.round_once()
+
+    def start(self) -> None:
+        self.trainer.start()
+
+    def rollback(self, name: str, *, reason: str = "manual"):
+        return self.promoter.rollback(name, reason=reason)
+
+    # ------------------------------------------------------------ health
+    def health_doc(self) -> dict:
+        staleness = self.buffer.staleness_s()
+        kernels = {}
+        for name in self.trainer.names():
+            entry = self.serve.registry.get(name)
+            doc = {"version": entry.version,
+                   "watch": self.promoter.watching(name)}
+            losses = self.promoter.last_losses.get(name)
+            if losses is not None:
+                doc["candidate_loss"], doc["resident_loss"] = losses
+            kernels[name] = doc
+        return {
+            "buffer": {
+                "depth": self.buffer.depth(),
+                "capacity": self.buffer.capacity,
+                "holdout": self.buffer.holdout_depth(),
+                "fed": self.buffer.total_fed(),
+                "dropped": self.buffer.dropped_total(),
+                "staleness_s": (None if staleness is None
+                                else round(staleness, 6)),
+            },
+            "trainer": dict(self.trainer.stats,
+                            running=self.trainer.running(),
+                            rows=self.trainer.rows,
+                            interval_s=self.trainer.interval_s),
+            "promoter": dict(self.promoter.stats),
+            "kernels": kernels,
+        }
+
+    # ------------------------------------------------------------- close
+    def close(self) -> None:
+        self.trainer.close()
+        if self._own_serve:
+            self.serve.close()

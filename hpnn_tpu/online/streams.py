@@ -1,0 +1,82 @@
+"""Demo stream drivers for the train-while-serve loop.
+
+Two infinite generators of ``(x, t)`` float64 pairs, built on the
+deterministic synthetic data tools so the online demo needs no
+downloads and replays bit-identically per seed:
+
+* :func:`mnist_stream` — randomized 28x28 digit renders
+  (``tools/synth_mnist.py``) flattened to 784 pixels in [0, 1] with
+  10-way one-hot targets: the paper's classic embedded-training
+  workload at streaming cadence.
+* :func:`xrd_stream` — synthetic powder-diffraction spectra
+  (``tools/synth_rruff.py``'s peak/render model) mean-pooled from the
+  fixed 2θ grid down to ``n_in`` bins, max-normalized, with a
+  ``classes``-way one-hot over deterministic per-class peak sets:
+  the pdif story as a stream.
+
+``take(stream, n)`` collects a block — handy for seeding eval sets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MNIST_N_IN = 28 * 28
+MNIST_N_OUT = 10
+
+
+def _one_hot(i: int, n: int) -> np.ndarray:
+    t = np.zeros(n, dtype=np.float64)
+    t[int(i)] = 1.0
+    return t
+
+
+def mnist_stream(seed: int = 0):
+    """Infinite ``(x[784] in [0,1], one-hot t[10])`` generator of
+    randomized digit renders (deterministic per seed)."""
+    from hpnn_tpu.tools import synth_mnist
+
+    rng = np.random.RandomState(seed)
+    while True:
+        digit = int(rng.randint(10))
+        img = synth_mnist.render(digit, rng)
+        x = img.reshape(-1).astype(np.float64) / 255.0
+        yield x, _one_hot(digit, MNIST_N_OUT)
+
+
+def xrd_stream(seed: int = 0, *, n_in: int = 128, classes: int = 8):
+    """Infinite ``(x[n_in], one-hot t[classes])`` generator of noisy
+    synthetic diffraction spectra.  Each class is a deterministic
+    space-group peak set (``class_peaks``); every draw renders a fresh
+    noisy spectrum of one class, pooled to ``n_in`` bins and
+    max-normalized."""
+    from hpnn_tpu.tools import synth_rruff
+
+    rng = np.random.RandomState(seed)
+    # stable per-class characteristic peaks (class i -> space group)
+    peaks = [synth_rruff.class_peaks(1 + 3 * i, seed)
+             for i in range(int(classes))]
+    while True:
+        cls = int(rng.randint(classes))
+        pos, inten = peaks[cls]
+        _grid, y, _jp, _ji = synth_rruff.render_spectrum(pos, inten,
+                                                         rng)
+        # mean-pool the fixed grid down to n_in bins (truncate the
+        # remainder so the pooling is exact)
+        k = y.shape[0] // n_in
+        x = y[:k * n_in].reshape(n_in, k).mean(axis=1)
+        peak = x.max()
+        if peak > 0:
+            x = x / peak
+        yield x.astype(np.float64), _one_hot(cls, int(classes))
+
+
+def take(stream, n: int):
+    """Collect ``n`` samples from a stream: ``(X (n, n_in),
+    T (n, n_out))`` float64 blocks."""
+    xs, ts = [], []
+    for _ in range(int(n)):
+        x, t = next(stream)
+        xs.append(x)
+        ts.append(t)
+    return np.stack(xs), np.stack(ts)

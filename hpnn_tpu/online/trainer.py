@@ -1,0 +1,256 @@
+"""Background candidate training over the streaming buffer.
+
+Each round: snapshot a fixed-size training window from the
+:class:`~hpnn_tpu.online.ingest.SampleBuffer`, run fused banked
+epochs on *candidate* weights starting from the resident version,
+then hand every candidate to the promotion gate
+(:class:`~hpnn_tpu.online.promote.Promoter`).  Serving never blocks:
+training runs on its own thread against copies, and promotion is the
+registry's atomic entry swap.
+
+The epoch engine is the scan-ordered bank from ``train/fleet.py`` —
+the exact structure of ``train/driver.py``'s bank mode
+(``batch.make_multi_epoch_bank_fn``) with the pure-jnp step, jitted
+once per topology and reused every round (the window size is fixed,
+so shapes never retrigger compilation).  When two or more tracked
+kernels share a topology the round trains them **fleet-wise**: one
+stacked dispatch for the whole group (``make_fleet_epoch_fn``), each
+member on its own RNG stream over the shared window.
+
+Knobs (read once, at construction; args override):
+``HPNN_ONLINE_ROWS`` (window, default 64), ``HPNN_ONLINE_BATCH``
+(default 8, must divide rows), ``HPNN_ONLINE_EPOCHS`` (default 4),
+``HPNN_ONLINE_INTERVAL_S`` (background cadence, default 1.0).
+
+Observability: ``online.round`` events, ``online.train_round`` spans,
+``online.train_loss`` / ``online.staleness_s`` gauges,
+``online.round_failed`` counts.  Catalog: docs/online.md.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+from hpnn_tpu import obs
+from hpnn_tpu.online.ingest import _env_float, _env_int
+
+
+class OnlineTrainer:
+    """Snapshot → train → gate, once per ``interval_s`` on a daemon
+    thread (``start()``) or by hand (``round_once()``, the test
+    path).  ``candidate_hook(name, weights) -> weights`` is a
+    test/chaos seam applied to each candidate between training and
+    the gate (e.g. NaN injection for the rejection drill)."""
+
+    def __init__(self, buffer, session, promoter, *,
+                 rows: int | None = None, batch: int | None = None,
+                 epochs: int | None = None,
+                 interval_s: float | None = None,
+                 momentum: bool = False, replay_frac: float = 0.25,
+                 seed: int = 0, clock=time.monotonic):
+        self.buffer = buffer
+        self.session = session      # serve.Session
+        self.promoter = promoter
+        self.rows = int(rows if rows is not None
+                        else _env_int("HPNN_ONLINE_ROWS", 64))
+        self.batch = int(batch if batch is not None
+                         else _env_int("HPNN_ONLINE_BATCH", 8))
+        self.epochs = int(epochs if epochs is not None
+                          else _env_int("HPNN_ONLINE_EPOCHS", 4))
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else _env_float("HPNN_ONLINE_INTERVAL_S", 1.0))
+        if self.rows % self.batch:
+            raise ValueError(
+                f"batch {self.batch} must divide rows {self.rows}")
+        self.momentum = bool(momentum)
+        self.replay_frac = float(replay_frac)
+        self.eval_set = None        # overrides the buffer's holdout
+        self.candidate_hook = None
+        self._seed = int(seed)
+        self._clock = clock
+        self._names: list[str] = []
+        self._fns: dict = {}        # (kind, n_steps, model, members)
+        self._round = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.stats = {"rounds": 0, "starved": 0, "trained": 0,
+                      "failed": 0}
+
+    # ----------------------------------------------------------- kernels
+    def track(self, name: str) -> None:
+        """Manage ``name`` (must already be resident in the serve
+        registry): train candidates for it and gate promotions."""
+        self.session.registry.get(name)     # KeyError when unknown
+        with self._lock:
+            if name not in self._names:
+                self._names.append(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._names)
+
+    # ---------------------------------------------------------- epoch fns
+    def _fn(self, kind: str, n_steps: int, model: str, members: int):
+        """Per-topology jit cache: the window size is fixed, so one
+        compile per (kind, model, member-count) serves every round."""
+        from hpnn_tpu.train import fleet
+
+        key = (kind, n_steps, model, self.momentum, members)
+        fn = self._fns.get(key)
+        if fn is None:
+            maker = (fleet.make_fleet_epoch_fn if kind == "fleet"
+                     else fleet.make_member_epoch_fn)
+            fn = maker(n_steps, model=model, momentum=self.momentum,
+                       count=False)
+            self._fns[key] = fn
+        return fn
+
+    def _zeros_dw(self, weights):
+        import jax.numpy as jnp
+
+        if not self.momentum:
+            return ()
+        return tuple(jnp.zeros_like(w) for w in weights)
+
+    # ------------------------------------------------------------- round
+    def _train_group(self, entries, X, T):
+        """Train one same-topology group; returns
+        ``{name: (weights, final_loss)}`` — fleet-stacked when the
+        group has 2+ members, the single-member bank run otherwise."""
+        import jax.numpy as jnp
+
+        from hpnn_tpu.train import fleet
+
+        n_steps = self.rows // self.batch
+        model = entries[0].model
+        seeds = [self._seed + 7919 * self._round + i
+                 for i in range(len(entries))]
+        if len(entries) >= 2:
+            stacked = fleet.stack_kernels([e.kernel for e in entries])
+            perms, orders = fleet.fleet_plan(
+                seeds, n_rows=self.rows, batch=self.batch,
+                epochs=self.epochs)
+            fn = self._fn("fleet", n_steps, model, len(entries))
+            w2, _dw, losses, _ = fn(stacked, self._zeros_dw(stacked),
+                                    X, T, perms, orders)
+            members = fleet.unstack_kernels(w2)
+            losses = np.asarray(losses)     # (N, epochs, steps)
+            return {
+                e.name: (members[i].weights,
+                         float(losses[i, -1].mean()))
+                for i, e in enumerate(entries)
+            }
+        entry = entries[0]
+        w = tuple(jnp.asarray(wl) for wl in entry.kernel.weights)
+        perms, orders = fleet.member_plan(
+            seeds[0], n_rows=self.rows, batch=self.batch,
+            epochs=self.epochs)
+        fn = self._fn("member", n_steps, model, 1)
+        w2, _dw, losses, _ = fn(w, self._zeros_dw(w), X, T, perms,
+                                orders)
+        cand = tuple(np.asarray(wl) for wl in w2)
+        return {entry.name: (cand,
+                             float(np.asarray(losses)[-1].mean()))}
+
+    def round_once(self) -> dict:
+        """One trainer round; returns its summary (also emitted as the
+        ``online.round`` event)."""
+        names = self.names()
+        staleness = self.buffer.staleness_s()
+        if staleness is not None:
+            obs.gauge("online.staleness_s", round(staleness, 6))
+        obs.gauge("online.buffer_depth", self.buffer.depth())
+        summary = {"round": self._round, "trained": 0, "promoted": 0,
+                   "rejected": 0, "rolled_back": 0,
+                   "outcomes": {}}
+        if not names or self.buffer.depth() < self.rows:
+            self.stats["starved"] += 1
+            summary["starved"] = True
+            summary["rolled_back"] = len(self.promoter.check_watch())
+            return summary
+        t0 = self._clock()
+        X, T, meta = self.buffer.snapshot(self.rows,
+                                          replay_frac=self.replay_frac)
+        # group tracked kernels by topology: 2+ members -> one
+        # stacked fleet dispatch, singletons -> the member bank run
+        groups: dict = {}
+        for name in names:
+            entry = self.session.registry.get(name)
+            topo = (entry.model,
+                    tuple(tuple(int(d) for d in w.shape)
+                          for w in entry.kernel.weights))
+            groups.setdefault(topo, []).append(entry)
+        candidates: dict = {}
+        with obs.spans.span("online.train_round", round=self._round,
+                            members=len(names), rows=self.rows,
+                            replay=meta["replay"]):
+            for entries in groups.values():
+                candidates.update(self._train_group(entries, X, T))
+        train_s = self._clock() - t0
+        eval_set = (self.eval_set if self.eval_set is not None
+                    else self.buffer.eval_snapshot())
+        for name, (cand, loss) in candidates.items():
+            obs.gauge("online.train_loss", loss, kernel=name)
+            if self.candidate_hook is not None:
+                hooked = self.candidate_hook(name, cand)
+                if hooked is not None:
+                    cand = hooked
+            outcome = self.promoter.consider(name, cand, eval_set,
+                                             step=self._round)
+            summary["outcomes"][name] = outcome
+            if outcome == "promoted":
+                summary["promoted"] += 1
+            else:
+                summary["rejected"] += 1
+        summary["trained"] = len(candidates)
+        summary["rolled_back"] = len(self.promoter.check_watch())
+        obs.event("online.round", round=self._round, rows=self.rows,
+                  members=len(names), groups=len(groups),
+                  replay=meta["replay"], promoted=summary["promoted"],
+                  rejected=summary["rejected"],
+                  rolled_back=summary["rolled_back"],
+                  train_s=round(train_s, 6))
+        self.stats["rounds"] += 1
+        self.stats["trained"] += len(candidates)
+        self._round += 1
+        return summary
+
+    # ------------------------------------------------------- thread loop
+    def start(self) -> None:
+        """Run rounds every ``interval_s`` on a daemon thread (no-op
+        when already running)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="hpnn-online-trainer")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.round_once()
+            except Exception as exc:   # the loop must survive a round
+                self.stats["failed"] += 1
+                obs.count("online.round_failed",
+                          error=type(exc).__name__)
+                sys.stderr.write(f"online: round failed: {exc}\n")
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def close(self, *, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+        self._thread = None

@@ -35,6 +35,10 @@ class Entry(NamedTuple):
     ``version`` increments on every (re)load of the same name —
     the engine keys compiled executables on it.  ``path``/``mtime``
     are None for kernels registered from memory (no reload source).
+    ``sig`` is the file's staleness signature ``(st_mtime_ns,
+    st_size)``: float mtime alone cannot see a same-second rewrite on
+    coarse-timestamp filesystems, a race the online trainer's rapid
+    promote cadence makes realistic (docs/online.md).
     """
 
     name: str
@@ -43,6 +47,7 @@ class Entry(NamedTuple):
     version: int
     path: str | None
     mtime: float | None
+    sig: tuple | None = None
 
     @property
     def n_inputs(self) -> int:
@@ -78,6 +83,7 @@ class Registry:
     def register(
         self, name: str, kernel: kernel_mod.Kernel, *, model: str = "ann",
         path: str | None = None, mtime: float | None = None,
+        sig: tuple | None = None,
     ) -> Entry:
         """Install (or replace) ``name`` with in-memory weights."""
         _check_model(model)
@@ -86,7 +92,8 @@ class Registry:
         with self._lock:
             prev = self._entries.get(name)
             version = prev.version + 1 if prev is not None else 0
-            entry = Entry(name, kernel, model, version, path, mtime)
+            entry = Entry(name, kernel, model, version, path, mtime,
+                          sig)
             self._entries[name] = entry
         obs.count("serve.kernel_load", kernel=name, version=version,
                   source="file" if path else "memory")
@@ -96,12 +103,34 @@ class Registry:
         """Load a kernel text file and install it under ``name``."""
         _check_model(model)
         try:
-            mtime = os.stat(path).st_mtime
+            st = os.stat(path)
             _fname, kernel = kernel_mod.load(path)
         except OSError as exc:
             raise RegistryError(f"cannot read kernel file {path}: {exc}")
         return self.register(name, kernel, model=model, path=path,
-                             mtime=mtime)
+                             mtime=st.st_mtime,
+                             sig=(st.st_mtime_ns, st.st_size))
+
+    def install(self, name: str, kernel: kernel_mod.Kernel, *,
+                model: str | None = None) -> Entry:
+        """Install new weights for an EXISTING name as a new version,
+        entirely in memory — the online promotion path (no disk
+        round-trip; docs/online.md).  The prior entry's ``path`` /
+        ``mtime`` / ``sig`` carry forward, so a later *file* rewrite
+        still hot-reloads over the promoted weights (disk wins)."""
+        try:
+            prev = self.get(name)
+        except KeyError:
+            raise RegistryError(
+                f"cannot install over unknown kernel {name!r}; "
+                "register/load it first")
+        entry = self.register(name, kernel,
+                              model=prev.model if model is None
+                              else model,
+                              path=prev.path, mtime=prev.mtime,
+                              sig=prev.sig)
+        obs.count("serve.install", kernel=name, version=entry.version)
+        return entry
 
     # ------------------------------------------------------------ lookup
     def get(self, name: str) -> Entry:
@@ -132,8 +161,12 @@ class Registry:
         return new
 
     def maybe_reload(self, name: str) -> bool:
-        """Hot-reload ``name`` if its file's mtime changed since the
-        last (re)load.  Returns True when a new version was installed.
+        """Hot-reload ``name`` if its file changed since the last
+        (re)load.  Staleness compares ``(st_mtime_ns, st_size)`` —
+        float mtime misses a same-second rewrite on coarse-timestamp
+        filesystems (and two rewrites within the double's ~200 ns
+        resolution), while the size catches even an equal-timestamp
+        overwrite.  Returns True when a new version was installed.
         A vanished or unreadable file keeps the resident version (a
         serving process must not drop a kernel over a torn overwrite);
         the failed probe is counted, not raised."""
@@ -141,12 +174,15 @@ class Registry:
         if entry.path is None:
             return False
         try:
-            mtime = os.stat(entry.path).st_mtime
+            st = os.stat(entry.path)
         except OSError:
             obs.count("serve.reload_failed", kernel=name, reason="stat")
             return False
-        if entry.mtime is not None and mtime == entry.mtime:
-            return False
+        if entry.sig is not None:
+            if (st.st_mtime_ns, st.st_size) == tuple(entry.sig):
+                return False
+        elif entry.mtime is not None and st.st_mtime == entry.mtime:
+            return False  # pre-sig entry (registered with mtime only)
         try:
             self.load(name, entry.path, model=entry.model)
         except Exception:

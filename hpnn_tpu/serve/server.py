@@ -18,6 +18,10 @@ same Session, for drivers that aren't Python:
   (client-sent ``req_id`` honored, else edge-minted) that threads
   through the request's spans (docs/serving.md).
 * ``POST /v1/reload`` ``{"kernel": n}`` → re-read the kernel file.
+* ``POST /ingest`` (alias ``/v1/ingest``)
+  ``{"kernel": n?, "inputs": [...], "targets": [...]}`` → feed the
+  online-learning sample buffer when an ``OnlineSession`` is attached
+  (hpnn_tpu/online/; docs/online.md); 404 on a plain serving process.
 * ``GET /healthz`` → kernel/bucket census, bucket-compile count,
   per-kernel queue depth + oldest-waiter age + shed/expired
   counters, SLO verdict, process obs health.
@@ -91,6 +95,12 @@ class Session:
         self._lock = threading.Lock()
         self._batchers: dict[str, Batcher] = {}
         self._closed = False
+        # the online-learning layer (hpnn_tpu/online/) plugs in here:
+        # ingest_hook(kernel|None, X, T) -> dict serves POST /ingest;
+        # online_health() -> dict becomes /healthz's "online" section.
+        # Both stay None on a plain serving process (route answers 404)
+        self.ingest_hook = None
+        self.online_health = None
 
     # ------------------------------------------------------------ kernels
     def load_kernel(self, name: str, path: str, *,
@@ -127,6 +137,20 @@ class Session:
         self.engine.evict(name, keep_version=entry.version)
         return True
 
+    def install_kernel(self, name: str, kernel: kernel_mod.Kernel, *,
+                       warmup: bool = True):
+        """Atomically promote in-memory ``kernel`` as a new version of
+        resident ``name`` (the online promotion path, no disk
+        round-trip): registry entry swap, engine warmed on the new
+        version, old executables evicted.  In-flight batches finish
+        on the entry they dispatched with — a request observes the
+        old or the new version, never a torn mix (docs/online.md)."""
+        entry = self.registry.install(name, kernel)
+        if warmup:
+            self.engine.warmup([name])
+        self.engine.evict(name, keep_version=entry.version)
+        return entry
+
     def kernels(self) -> list[str]:
         return self.registry.names()
 
@@ -153,6 +177,8 @@ class Session:
         doc["numerics"] = obs.probes.health_doc(self.registry.names())
         doc["obs"] = obs.export.health()
         doc["slo"] = obs.slo.health_doc()
+        if self.online_health is not None:
+            doc["online"] = self.online_health()
         return doc
 
     # ------------------------------------------------------------ infer
@@ -325,6 +351,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._infer(req)
         elif self.path == "/v1/reload":
             self._reload(req)
+        elif self.path in ("/ingest", "/v1/ingest"):
+            self._ingest(req)
         else:
             self._reply(404, {"error": f"no such path {self.path}"})
 
@@ -373,6 +401,40 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {"kernel": name, "req_id": req_id,
                               "outputs": np.asarray(out).tolist()},
                         headers=rid_hdr)
+
+    def _ingest(self, req: dict):
+        """``POST /ingest`` ``{"kernel": n?, "inputs": [[...]],
+        "targets": [[...]]}`` → ``{"accepted": N, "depth": D}``.
+        Feeds the online-learning sample buffer; 404 when no online
+        session is attached (plain serving process) or the kernel is
+        unknown, 400 on malformed/width-mismatched samples."""
+        hook = self.session.ingest_hook
+        if hook is None:
+            self._reply(404, {"error": "online ingest not enabled"})
+            return
+        try:
+            inputs = np.asarray(req.get("inputs"), dtype=np.float64)
+            targets = np.asarray(req.get("targets"), dtype=np.float64)
+        except (TypeError, ValueError):
+            self._reply(400, {"error": "inputs/targets must be "
+                                       "numeric"})
+            return
+        if inputs.ndim not in (1, 2) or targets.ndim not in (1, 2):
+            self._reply(400, {"error": "inputs/targets must be "
+                                       "vectors or lists of vectors"})
+            return
+        kernel = req.get("kernel")
+        if kernel is not None and not isinstance(kernel, str):
+            self._reply(400, {"error": "kernel must be a string"})
+            return
+        try:
+            out = hook(kernel, inputs, targets)
+        except KeyError:
+            self._reply(404, {"error": f"unknown kernel {kernel!r}"})
+        except ValueError as exc:
+            self._reply(400, {"error": str(exc)})
+        else:
+            self._reply(200, out)
 
     def _reload(self, req: dict):
         name = req.get("kernel", "default")

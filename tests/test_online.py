@@ -1,0 +1,802 @@
+"""Train-while-serve: the online-learning subsystem
+(hpnn_tpu/online/, docs/online.md).
+
+Covers the stream buffer (ring/reservoir/holdout, fake clocks), the
+promotion gate (sentinel / margin / eval rejections, atomic install,
+bitwise rollback, the post-promotion regression watch), fleet-wise
+candidate training, the ``POST /ingest`` HTTP route and loadgen
+``--mix``, the registry's ``(st_mtime_ns, st_size)`` staleness
+signature, the ``check_obs_catalog --online`` schema lint, and the
+acceptance E2E: an MNIST-stream kernel ingesting under live loadgen
+traffic promotes a sentinel-clean candidate (version bump +
+``online.promote``), improves on held-out eval, and rejects an
+injected-NaN candidate while serving continues.
+
+Promotion-race guarantee (ISSUE satellite): a client racing a
+promotion sees the old version's answer or the new version's answer,
+bitwise — never a torn mix — and rollback restores bitwise-identical
+answers.
+"""
+
+import http.client
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, online, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.online import promote as promote_mod
+from hpnn_tpu.online import streams
+from hpnn_tpu.online.ingest import SampleBuffer
+from hpnn_tpu.serve.registry import Registry, RegistryError
+from hpnn_tpu.serve.server import make_server
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _kernel(seed=7, n_in=8, hidden=(5,), n_out=2):
+    k, _ = kernel_mod.generate(seed, n_in, list(hidden), n_out)
+    return k
+
+
+def _stream_block(n, seed=3, n_in=8, n_out=2):
+    """A learnable synthetic stream block: targets a smooth function
+    of the inputs, so training from a random init reliably improves."""
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(0.0, 1.0, size=(n, n_in))
+    return X, np.tanh(X[:, :n_out])
+
+
+def _mk_osess(**kw):
+    defaults = dict(
+        serve_kwargs=dict(max_batch=8, n_buckets=2, max_wait_ms=1.0),
+        rows=16, batch=8, epochs=4, interval_s=60.0, holdout=4,
+        gate=online.Gate(margin=0.0, watch_s=30.0), seed=5)
+    defaults.update(kw)
+    return online.OnlineSession(**defaults)
+
+
+def _tick_until_promoted(osess, max_ticks=6):
+    for _ in range(max_ticks):
+        summary = osess.tick()
+        if summary["promoted"]:
+            return summary
+    raise AssertionError(f"no promotion within {max_ticks} rounds")
+
+
+def _weights_of(osess, name):
+    return tuple(np.asarray(w)
+                 for w in osess.serve.registry.get(name).kernel.weights)
+
+
+# ======================================================== SampleBuffer
+def test_buffer_ring_drop_staleness_fake_clock():
+    clock = FakeClock()
+    buf = SampleBuffer(capacity=4, holdout=0, clock=clock)
+    assert buf.staleness_s() is None
+    X, T = _stream_block(6)
+    buf.feed(X[:2], T[:2])
+    clock.advance(3.0)
+    assert buf.feed(X[2:], T[2:]) == 4
+    assert buf.depth() == 4                  # ring holds the newest 4
+    assert buf.dropped_total() == 2          # the two oldest evicted
+    assert buf.total_fed() == 6
+    assert buf.widths() == (8, 2)
+    assert buf.staleness_s() == 0.0
+    clock.advance(1.5)
+    assert buf.staleness_s() == pytest.approx(1.5)
+    # the snapshot is the newest rows, as copies
+    Xs, Ts, meta = buf.snapshot(4)
+    assert np.array_equal(Xs, X[2:]) and np.array_equal(Ts, T[2:])
+    assert meta["rows"] == 4 and meta["replay"] == 0
+    assert meta["staleness_s"] == pytest.approx(1.5)
+    Xs[0, 0] = 99.0                          # mutating a copy is safe
+    assert buf.snapshot(4)[0][0, 0] == X[2, 0]
+    with pytest.raises(ValueError):
+        buf.snapshot(5)
+
+
+def test_buffer_holdout_diverted_never_trained():
+    buf = SampleBuffer(capacity=64, holdout=3)
+    X, T = _stream_block(9, seed=1)
+    buf.feed(X, T)
+    assert buf.holdout_depth() == 3          # every 3rd diverted
+    assert buf.depth() == 6                  # ... and NOT in the ring
+    Xh, Th = buf.eval_snapshot()
+    assert Xh.shape == (3, 8) and Th.shape == (3, 2)
+    assert np.array_equal(Xh[0], X[2])       # samples 3, 6, 9 (1-based)
+    Xs, _, _ = buf.snapshot(6)
+    for row in Xh:                           # holdout rows never train
+        assert not any(np.array_equal(row, r) for r in Xs)
+
+
+def test_buffer_reservoir_replay_and_width_pinning():
+    buf = SampleBuffer(capacity=8, reservoir=6, holdout=0, seed=0)
+    X, T = _stream_block(40, seed=2)
+    buf.feed(X, T)
+    Xs, _, meta = buf.snapshot(8, replay_frac=0.5)
+    assert meta["replay"] == 4               # oldest half swapped
+    assert Xs.shape == (8, 8)
+    # the newest half is still the ring tail, in order
+    assert np.array_equal(Xs[4:], X[-4:])
+    with pytest.raises(ValueError):
+        buf.feed(np.zeros((2, 5)), np.zeros((2, 2)))   # width mismatch
+    with pytest.raises(ValueError):
+        buf.feed(np.zeros((2, 8)), np.zeros((3, 2)))   # row mismatch
+
+
+# ============================================ registry staleness (sig)
+def test_registry_sig_catches_sub_second_rewrite(tmp_path):
+    path = tmp_path / "kernel.opt"
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=1), fp)
+    reg = Registry()
+    e0 = reg.load("k", str(path))
+    assert e0.sig == (os.stat(path).st_mtime_ns, os.stat(path).st_size)
+    assert reg.maybe_reload("k") is False
+    # rewrite, then pin the mtime ONE NANOSECOND later: the float
+    # st_mtime collapses to the same double, so the old float compare
+    # cannot see this rewrite — the ns signature can
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=2), fp)
+    ns = e0.sig[0] + 1
+    os.utime(path, ns=(ns, ns))
+    assert os.stat(path).st_mtime == e0.mtime   # float is blind...
+    assert reg.maybe_reload("k") is True        # ...the sig is not
+    assert reg.get("k").version == 1
+
+
+def test_registry_sig_size_catches_equal_timestamp_rewrite(tmp_path):
+    path = tmp_path / "kernel.opt"
+    path.write_text("x" * 10)
+    reg = Registry()
+    reg.register("k", _kernel(seed=1), path=str(path),
+                 mtime=os.stat(path).st_mtime,
+                 sig=(os.stat(path).st_mtime_ns,
+                      os.stat(path).st_size))
+    st0 = os.stat(path)
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=2), fp)   # different size
+    os.utime(path, ns=(st0.st_mtime_ns, st0.st_mtime_ns))
+    st1 = os.stat(path)
+    assert st1.st_mtime_ns == st0.st_mtime_ns   # timestamp identical
+    assert st1.st_size != st0.st_size
+    assert reg.maybe_reload("k") is True
+
+
+def test_registry_pre_sig_entry_falls_back_to_float_mtime(tmp_path):
+    path = tmp_path / "kernel.opt"
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=1), fp)
+    reg = Registry()
+    e = reg.register("k", _kernel(seed=1), path=str(path),
+                     mtime=os.stat(path).st_mtime)   # sig=None
+    assert e.sig is None
+    assert reg.maybe_reload("k") is False        # same float mtime
+    os.utime(path, (e.mtime + 10, e.mtime + 10))
+    assert reg.maybe_reload("k") is True
+
+
+def test_registry_install_bumps_version_and_keeps_disk_wins(tmp_path):
+    path = tmp_path / "kernel.opt"
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=1), fp)
+    reg = Registry()
+    e0 = reg.load("k", str(path))
+    e1 = reg.install("k", _kernel(seed=2))
+    assert e1.version == e0.version + 1
+    assert e1.model == e0.model
+    assert (e1.path, e1.mtime, e1.sig) == (e0.path, e0.mtime, e0.sig)
+    # a later DISK rewrite still hot-reloads over the promotion
+    with open(path, "w") as fp:
+        kernel_mod.dump("t", _kernel(seed=3), fp)
+    os.utime(path, (e0.mtime + 10, e0.mtime + 10))
+    assert reg.maybe_reload("k") is True
+    assert reg.get("k").version == e1.version + 1
+    with pytest.raises(RegistryError):
+        reg.install("nope", _kernel(seed=1))
+
+
+# ====================================================== promotion gate
+def test_promote_then_margin_reject(tmp_path):
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        osess = _mk_osess()
+        osess.add_kernel("k", _kernel(seed=9))
+        osess.feed(*_stream_block(48, seed=3))
+        v0 = osess.serve.registry.get("k").version
+        y0 = osess.infer("k", np.ones(8))
+        summary = _tick_until_promoted(osess)
+        assert summary["outcomes"]["k"] == "promoted"
+        assert osess.serve.registry.get("k").version == v0 + 1
+        assert osess.promoter.stats["promoted"] == 1
+        assert osess.promoter.last_promote_latency_s is not None
+        # the promoted weights answer differently
+        assert not np.array_equal(osess.infer("k", np.ones(8)), y0)
+        # a candidate identical to the resident cannot clear a strict
+        # margin: deterministic margin rejection
+        osess.trainer.candidate_hook = \
+            lambda name, w: _weights_of(osess, name)
+        summary = osess.tick()
+        assert summary["outcomes"]["k"] == "margin"
+        assert osess.serve.registry.get("k").version == v0 + 1
+        osess.close()
+    finally:
+        obs.configure(None)
+    recs = _read(sink)
+    promo = [r for r in recs if r["ev"] == "online.promote"]
+    assert len(promo) == 1 and promo[0]["kernel"] == "k"
+    assert promo[0]["to_version"] == promo[0]["from_version"] + 1
+    assert promo[0]["cand_loss"] < promo[0]["res_loss"]
+    rej = [r for r in recs if r["ev"] == "online.reject"]
+    assert rej and rej[-1]["reason"] == "margin"
+    assert any(r["ev"] == "serve.install" for r in recs)
+    assert any(r["ev"] == "online.round" for r in recs)
+
+
+def test_nan_candidate_rejected_serving_continues(tmp_path):
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        osess = _mk_osess()
+        osess.add_kernel("k", _kernel(seed=9))
+        osess.feed(*_stream_block(32, seed=3))
+        v0 = osess.serve.registry.get("k").version
+        y0 = osess.infer("k", np.ones(8))
+
+        def poison(name, w):
+            bad = [np.asarray(x).copy() for x in w]
+            bad[0][0, 0] = np.nan
+            return tuple(bad)
+
+        osess.trainer.candidate_hook = poison
+        summary = osess.tick()
+        assert summary["outcomes"]["k"] == "sentinel"
+        # the resident version keeps serving, bitwise
+        assert osess.serve.registry.get("k").version == v0
+        assert np.array_equal(osess.infer("k", np.ones(8)), y0)
+        osess.close()
+    finally:
+        obs.configure(None)
+    rej = [r for r in _read(sink) if r["ev"] == "online.reject"]
+    assert rej and rej[0]["reason"] == "sentinel"
+    assert not any(r["ev"] == "online.promote" for r in _read(sink))
+
+
+def test_no_holdout_means_eval_reject_never_blind_promotion():
+    osess = _mk_osess(holdout=0)
+    osess.add_kernel("k", _kernel(seed=9))
+    osess.feed(*_stream_block(32, seed=3))
+    summary = osess.tick()
+    assert summary["outcomes"]["k"] == "eval"
+    assert osess.serve.registry.get("k").version == 0
+    osess.close()
+
+
+def test_rollback_restores_bitwise_identical_answers():
+    osess = _mk_osess()
+    osess.add_kernel("k", _kernel(seed=9))
+    osess.feed(*_stream_block(48, seed=3))
+    X = np.linspace(-1.0, 1.0, 8)
+    y_before = osess.infer("k", X)
+    _tick_until_promoted(osess)
+    y_promoted = osess.infer("k", X)
+    assert not np.array_equal(y_before, y_promoted)
+    entry = osess.rollback("k")
+    assert entry is not None and entry.version == 2   # never rewinds
+    assert np.array_equal(osess.infer("k", X), y_before)   # bitwise
+    assert osess.rollback("k") is None       # nothing left to undo
+    osess.close()
+
+
+def test_watch_rolls_back_on_serve_numerics_regression(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_PROBES", "1")
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        osess = _mk_osess()
+        osess.add_kernel("k", _kernel(seed=9))
+        osess.feed(*_stream_block(48, seed=3))
+        y_before = osess.infer("k", np.ones(8))
+        _tick_until_promoted(osess)
+        assert osess.promoter.watching("k")
+        # a post-promotion dispatch goes NaN: the next watch scan must
+        # roll the promotion back
+        obs.probes.note_serve("k", rows=4, nan=2)
+        assert osess.promoter.check_watch() == ["k"]
+        assert not osess.promoter.watching("k")
+        assert np.array_equal(osess.infer("k", np.ones(8)), y_before)
+        osess.close()
+    finally:
+        obs.configure(None)
+    rb = [r for r in _read(sink) if r["ev"] == "online.rollback"]
+    assert rb and rb[0]["reason"] == "numerics"
+    assert rb[0]["to_version"] > rb[0]["from_version"]
+
+
+def test_watch_rolls_back_on_slo_breach(monkeypatch):
+    osess = _mk_osess()
+    osess.add_kernel("k", _kernel(seed=9))
+    osess.feed(*_stream_block(48, seed=3))
+    y_before = osess.infer("k", np.ones(8))
+    _tick_until_promoted(osess)
+    monkeypatch.setattr(
+        obs.slo, "health_doc",
+        lambda: {"mode": "on", "served": 10, "verdict": "breach"})
+    assert osess.promoter.check_watch() == ["k"]
+    assert np.array_equal(osess.infer("k", np.ones(8)), y_before)
+    assert osess.promoter.stats["rollbacks"] == 1
+    osess.close()
+
+
+def test_watch_disarms_after_window_fake_clock(monkeypatch):
+    clock = FakeClock()
+    osess = _mk_osess(clock=clock,
+                      gate=online.Gate(margin=0.0, watch_s=5.0))
+    osess.add_kernel("k", _kernel(seed=9))
+    osess.feed(*_stream_block(48, seed=3))
+    _tick_until_promoted(osess)
+    assert osess.promoter.watching("k")
+    clock.advance(6.0)                       # past watch_s: disarm
+    assert osess.promoter.check_watch() == []
+    assert not osess.promoter.watching("k")
+    # a breach AFTER the window closed must not roll back
+    monkeypatch.setattr(
+        obs.slo, "health_doc",
+        lambda: {"mode": "on", "served": 10, "verdict": "breach"})
+    assert osess.promoter.check_watch() == []
+    assert osess.promoter.stats["rollbacks"] == 0
+    osess.close()
+
+
+# ====================================================== promotion race
+def test_promotion_race_answers_never_torn():
+    """Clients racing promotions/rollbacks see the old answer or the
+    new answer, bitwise — never a mix of versions."""
+    osess = _mk_osess(eval_set=_stream_block(16, seed=8))
+    osess.add_kernel("k", _kernel(seed=9))
+    osess.feed(*_stream_block(48, seed=3))
+    x = np.linspace(-1.0, 1.0, 8)
+    y_old = osess.infer("k", x)
+    _tick_until_promoted(osess)
+    w_good = _weights_of(osess, "k")
+    y_new = osess.infer("k", x)
+    assert not np.array_equal(y_old, y_new)
+    # pin the candidate: every promotion from here installs exactly
+    # w_good, so the only legal answers are y_old and y_new
+    osess.trainer.candidate_hook = lambda name, w: w_good
+    stop = threading.Event()
+    churn_err = []
+
+    def churn():
+        try:
+            while not stop.is_set():
+                osess.rollback("k")          # resident -> w_init
+                osess.tick()                 # resident -> w_good
+        except Exception as exc:             # pragma: no cover
+            churn_err.append(exc)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    try:
+        for _ in range(120):
+            y = osess.infer("k", x)
+            assert np.array_equal(y, y_old) or np.array_equal(y, y_new)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not churn_err
+    assert osess.promoter.stats["promoted"] >= 2   # races happened
+    osess.close()
+
+
+# ============================================ fleet-wise group training
+def test_same_topology_kernels_train_as_one_fleet_group(tmp_path):
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        osess = _mk_osess()
+        osess.add_kernel("a", _kernel(seed=9))
+        osess.add_kernel("b", _kernel(seed=11))          # same topology
+        osess.add_kernel("c", _kernel(seed=13, hidden=(4,)))  # not
+        osess.feed(*_stream_block(48, seed=3))
+        summary = osess.tick()
+        assert set(summary["outcomes"]) == {"a", "b", "c"}
+        osess.close()
+    finally:
+        obs.configure(None)
+    recs = _read(sink)
+    rounds = [r for r in recs if r["ev"] == "online.round"]
+    assert rounds and rounds[0]["members"] == 3
+    assert rounds[0]["groups"] == 2          # {a, b} stacked, {c} solo
+    losses = {r["kernel"] for r in recs
+              if r["ev"] == "online.train_loss"}
+    assert losses == {"a", "b", "c"}
+
+
+def test_starved_round_and_background_thread():
+    osess = _mk_osess(interval_s=0.01)
+    osess.add_kernel("k", _kernel(seed=9))
+    osess.feed(*_stream_block(8, seed=3))    # fewer than rows=16
+    summary = osess.tick()
+    assert summary.get("starved") is True
+    assert osess.trainer.stats["starved"] == 1
+    osess.feed(*_stream_block(48, seed=4))
+    osess.start()
+    assert osess.trainer.running()
+    deadline = time.monotonic() + 10.0
+    while (osess.trainer.stats["rounds"] < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert osess.trainer.stats["rounds"] >= 1
+    osess.close()
+    assert not osess.trainer.running()
+    doc = osess.health_doc()
+    assert doc["buffer"]["depth"] > 0
+    assert doc["kernels"]["k"]["version"] >= 0
+    assert "promoted" in doc["promoter"]
+
+
+def test_trainer_validates_batch_divides_rows():
+    with pytest.raises(ValueError):
+        _mk_osess(rows=16, batch=5)
+
+
+# ==================================================== HTTP POST /ingest
+def _post(port, path, body, timeout=10.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    try:
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def test_http_ingest_requires_online_session():
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    server = make_server(sess, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        code, body = _post(port, "/ingest",
+                           {"inputs": [0.0] * 8, "targets": [0.0, 0.0]})
+        assert code == 404 and "not enabled" in body["error"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+
+
+def test_http_ingest_feeds_buffer_and_validates():
+    osess = _mk_osess()
+    osess.add_kernel("k", _kernel(seed=9))
+    server = make_server(osess.serve, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        code, body = _post(port, "/ingest",
+                           {"inputs": [0.1] * 8, "targets": [1.0, -1.0]})
+        assert code == 200 and body == {"accepted": 1, "depth": 1}
+        X, T = _stream_block(4, seed=1)
+        code, body = _post(port, "/v1/ingest",
+                           {"kernel": "k", "inputs": X.tolist(),
+                            "targets": T.tolist()})
+        assert code == 200 and body["accepted"] == 4
+        assert osess.buffer.total_fed() == 5
+        code, body = _post(port, "/ingest",
+                           {"kernel": "nope", "inputs": [0.1] * 8,
+                            "targets": [0.0, 0.0]})
+        assert code == 404 and "nope" in body["error"]
+        code, _ = _post(port, "/ingest",
+                        {"inputs": "junk", "targets": [0.0, 0.0]})
+        assert code == 400
+        code, _ = _post(port, "/ingest",
+                        {"inputs": [0.1] * 5, "targets": [0.0, 0.0]})
+        assert code == 400                   # width mismatch
+        code, _ = _post(port, "/ingest",
+                        {"kernel": 7, "inputs": [0.1] * 8,
+                         "targets": [0.0, 0.0]})
+        assert code == 400
+        # /healthz grew the online section
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/healthz")
+        doc = json.loads(conn.getresponse().read().decode())
+        conn.close()
+        assert doc["online"]["buffer"]["depth"] >= 4
+        assert "k" in doc["online"]["kernels"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        osess.close()
+
+
+def test_loadgen_mix_interleaves_ingest_with_infer():
+    loadgen = _load_tool("loadgen")
+    osess = _mk_osess()
+    osess.add_kernel("k", _kernel(seed=9))
+    server = make_server(osess.serve, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        res = loadgen.run_closed_loop(
+            f"http://127.0.0.1:{port}", kernels=("k",),
+            rows_choices=(1, 2), n_in=8, n_out=2, n_clients=2,
+            duration_s=0.4, ingest_frac=0.5, seed=3, timeout_s=5.0,
+            max_retries=0)
+        assert res["ops"].get("ingest", 0) > 0
+        assert res["ops"].get("infer", 0) > 0
+        assert osess.buffer.total_fed() > 0
+    finally:
+        server.shutdown()
+        server.server_close()
+        osess.close()
+
+
+# ============================================================= streams
+def test_streams_shapes_and_determinism():
+    X1, T1 = streams.take(streams.mnist_stream(seed=4), 3)
+    X2, T2 = streams.take(streams.mnist_stream(seed=4), 3)
+    assert X1.shape == (3, 784) and T1.shape == (3, 10)
+    assert np.array_equal(X1, X2) and np.array_equal(T1, T2)
+    assert X1.min() >= 0.0 and X1.max() <= 1.0
+    assert np.array_equal(T1.sum(axis=1), np.ones(3))   # one-hot
+    Xx, Tx = streams.take(streams.xrd_stream(seed=4), 2)
+    assert Xx.shape == (2, 128) and Tx.shape == (2, 8)
+    assert Xx.max() <= 1.0 + 1e-12
+    assert np.array_equal(Tx.sum(axis=1), np.ones(2))
+
+
+def test_online_nn_build_from_conf_prefeeds_stream():
+    from hpnn_tpu.cli import online_nn
+    from hpnn_tpu.config import NNConf, NNTrain, NNType
+
+    conf = NNConf(name="demo", type=NNType.ANN, seed=1,
+                  kernel=_kernel(seed=2, n_in=784, hidden=(4,),
+                                 n_out=10),
+                  train=NNTrain.BP, samples=None, tests=None)
+    osess, server = online_nn.build_from_conf(conf, port=0,
+                                              stream="mnist",
+                                              stream_n=8)
+    try:
+        assert osess.kernels() == ["demo"]
+        assert osess.buffer.total_fed() == 8
+    finally:
+        server.server_close()
+        osess.close()
+    # width mismatch between stream and kernel is a startup error
+    bad = NNConf(name="bad", type=NNType.ANN, seed=1,
+                 kernel=_kernel(seed=2), train=NNTrain.BP,
+                 samples=None, tests=None)
+    with pytest.raises(ValueError):
+        online_nn.build_from_conf(bad, port=0, stream="mnist",
+                                  stream_n=4)
+
+
+# ==================================================== lint_online tool
+def _good_online_records():
+    return [
+        {"ts": 1.0, "ev": "online.ingest", "kind": "count", "n": 4,
+         "total": 4},
+        {"ts": 1.0, "ev": "online.buffer_depth", "kind": "gauge",
+         "value": 4.0},
+        {"ts": 1.1, "ev": "online.staleness_s", "kind": "gauge",
+         "value": 0.5},
+        {"ts": 1.2, "ev": "online.train_loss", "kind": "gauge",
+         "value": 0.3, "kernel": "k"},
+        {"ts": 1.2, "ev": "online.candidate_loss", "kind": "gauge",
+         "value": 0.2, "kernel": "k"},
+        {"ts": 1.2, "ev": "online.resident_loss", "kind": "gauge",
+         "value": 0.4, "kernel": "k"},
+        {"ts": 1.3, "ev": "serve.install", "kind": "count", "n": 1,
+         "total": 1, "kernel": "k", "version": 1},
+        {"ts": 1.3, "ev": "online.promote", "kind": "event",
+         "kernel": "k", "from_version": 0, "to_version": 1,
+         "cand_loss": 0.2, "res_loss": 0.4, "install_s": 0.001},
+        {"ts": 1.3, "ev": "online.promote_latency_ms", "kind": "gauge",
+         "value": 1.0, "kernel": "k"},
+        {"ts": 1.4, "ev": "online.reject", "kind": "event",
+         "kernel": "k", "reason": "margin", "step": 1},
+        {"ts": 1.5, "ev": "online.rollback", "kind": "event",
+         "kernel": "k", "from_version": 1, "to_version": 2,
+         "restored": 0, "reason": "numerics"},
+        {"ts": 1.6, "ev": "online.round", "kind": "event", "round": 0,
+         "rows": 16, "members": 1, "groups": 1, "replay": 0,
+         "promoted": 1, "rejected": 1, "rolled_back": 1,
+         "train_s": 0.01},
+    ]
+
+
+def _write_sink(path, recs):
+    with open(path, "w") as fp:
+        for r in recs:
+            fp.write(json.dumps(r) + "\n")
+
+
+def test_lint_online_passes_a_clean_sink(tmp_path):
+    cat = _load_tool("check_obs_catalog")
+    sink = tmp_path / "ok.jsonl"
+    _write_sink(sink, _good_online_records())
+    assert cat.lint_online(str(sink)) == []
+
+
+def test_lint_online_catches_contract_breaks(tmp_path):
+    cat = _load_tool("check_obs_catalog")
+    bad = _good_online_records()
+    bad[7]["to_version"] = 0                 # promote must bump
+    bad[9]["reason"] = "vibes"               # unknown reject reason
+    bad[1]["value"] = -1.0                   # negative depth
+    bad[11]["members"] = 0                   # empty round
+    sink = tmp_path / "bad.jsonl"
+    _write_sink(sink, bad)
+    failures = "\n".join(cat.lint_online(str(sink)))
+    assert "do not bump" in failures
+    assert "vibes" in failures
+    assert "negative" in failures
+    assert "members" in failures
+    # an empty sink fails: the lint demands evidence of online activity
+    empty = tmp_path / "empty.jsonl"
+    _write_sink(empty, [{"ts": 1.0, "ev": "serve.request",
+                         "kind": "timer", "dt": 0.1}])
+    assert any("no online.*" in f for f in cat.lint_online(str(empty)))
+    assert "docs/online.md" in cat.DOC_PAGES
+
+
+def test_lint_online_via_main_flag(tmp_path, capsys):
+    cat = _load_tool("check_obs_catalog")
+    sink = tmp_path / "ok.jsonl"
+    _write_sink(sink, _good_online_records())
+    assert cat.main(["--online", str(sink)]) == 0
+    bad = tmp_path / "bad.jsonl"
+    _write_sink(bad, [])
+    assert cat.main(["--online", str(bad)]) == 1
+    assert cat.main(["--online"]) == 2
+
+
+# ======================================================= E2E acceptance
+def test_e2e_mnist_stream_promotes_under_live_traffic(
+        tmp_path, monkeypatch):
+    """The ISSUE acceptance demo: an OnlineSession serving an
+    MNIST-stream kernel ingests under live loadgen traffic, promotes a
+    sentinel-clean candidate (version bump + ``online.promote``),
+    improves on held-out eval, and rejects an injected-NaN candidate
+    with ``online.reject`` while serving continues — and the recorded
+    sink lints clean under ``check_obs_catalog --online``."""
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    loadgen = _load_tool("loadgen")
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    osess = None
+    server = None
+    try:
+        # held-out eval: a stream block the trainer never feeds
+        Xe, Te = streams.take(streams.mnist_stream(seed=99), 48)
+        osess = online.OnlineSession(
+            serve_kwargs=dict(max_batch=16, n_buckets=3,
+                              max_wait_ms=1.0),
+            rows=32, batch=8, epochs=8, interval_s=60.0, holdout=8,
+            gate=online.Gate(margin=0.0, watch_s=30.0), seed=21,
+            eval_set=(Xe, Te))
+        k = _kernel(seed=21, n_in=784, hidden=(16,), n_out=10)
+        w_init = tuple(np.asarray(w) for w in k.weights)
+        osess.add_kernel("mnist", k)
+        stream = streams.mnist_stream(seed=5)
+        osess.feed(*streams.take(stream, 96))
+        server = make_server(osess.serve, port=0)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{port}"
+
+        # live mixed loadgen traffic (infer + POST /ingest) in the
+        # background while the trainer rounds run in the foreground
+        traffic = {}
+
+        def drive():
+            traffic["res"] = loadgen.run_closed_loop(
+                url, kernels=("mnist",), rows_choices=(1, 2),
+                n_in=784, n_out=10, n_clients=2, duration_s=2.5,
+                ingest_frac=0.3, seed=6, timeout_s=10.0,
+                max_retries=1)
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        fed_mark = osess.buffer.total_fed()
+        promoted = 0
+        for _ in range(6):
+            # keep the newest window dominated by real MNIST samples
+            # (loadgen's ingest bodies are random-target noise)
+            osess.feed(*streams.take(stream, 48))
+            summary = osess.tick()
+            promoted += summary["promoted"]
+            if promoted:
+                break
+        t.join(timeout=30)
+        assert "res" in traffic, "loadgen thread did not finish"
+        res = traffic["res"]
+        assert res["ops"].get("infer", 0) > 0
+        assert res["ops"].get("ingest", 0) > 0      # ingested under load
+        assert osess.buffer.total_fed() > fed_mark
+        assert res["ok"] > 0
+
+        # >=1 sentinel-clean promotion: version bumped, answers moved
+        assert promoted >= 1
+        entry = osess.serve.registry.get("mnist")
+        assert entry.version >= 1
+        # held-out eval improved: the resident strictly beats the
+        # initial weights on data it never trained on
+        loss_init = promote_mod.eval_loss(w_init, Xe, Te)
+        loss_now = promote_mod.eval_loss(
+            _weights_of(osess, "mnist"), Xe, Te)
+        assert loss_now < loss_init
+
+        # NaN drill: a poisoned candidate is rejected, serving
+        # continues on the promoted version
+        v_before = entry.version
+        y_before = osess.infer("mnist", Xe[0])
+
+        def poison(name, w):
+            bad = [np.asarray(x).copy() for x in w]
+            bad[0][0, 0] = np.nan
+            return tuple(bad)
+
+        osess.trainer.candidate_hook = poison
+        summary = osess.tick()
+        assert summary["outcomes"]["mnist"] == "sentinel"
+        assert osess.serve.registry.get("mnist").version == v_before
+        assert np.array_equal(osess.infer("mnist", Xe[0]), y_before)
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if osess is not None:
+            osess.close()
+        obs.configure(None)
+
+    recs = _read(sink)
+    names = {r["ev"] for r in recs}
+    assert "online.promote" in names
+    assert "online.reject" in names
+    assert "online.ingest" in names
+    assert "serve.install" in names
+    spans = [r for r in recs if r["ev"] == "span.end"
+             and r.get("name") == "online.train_round"]
+    assert spans and all(s["members"] >= 1 for s in spans)
+    # the audit trail lints clean
+    cat = _load_tool("check_obs_catalog")
+    assert cat.lint_online(str(sink)) == []
+    assert cat.check(ROOT) == []

@@ -60,6 +60,14 @@ GATE_METRICS = {
     "load_goodput_rps": ("higher", 0.40),
     "load_p99_ms": ("lower", 1.00),
     "load_goodput_vs_saturation": ("higher", 0.20),
+    # train-while-serve fold-in (tools/bench_online.py): serving
+    # goodput while the background trainer promotes candidates, the
+    # fraction of the idle-serve plateau it holds, and how long a
+    # gate-passed candidate takes to become resident (install +
+    # bucket-menu warmup)
+    "online_goodput_rps": ("higher", 0.40),
+    "online_goodput_vs_idle": ("higher", 0.25),
+    "online_promote_latency_ms": ("lower", 1.00),
 }
 
 

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Train-while-serve bench: goodput under background training vs the
+idle-serve plateau, plus promotion latency.
+
+The bench.py fold-in for the online-learning layer (docs/online.md):
+
+1. stand up an in-process ``OnlineSession`` over a tiny kernel and
+   pre-feed its stream buffer;
+2. **idle phase** — closed-loop infer traffic with the trainer
+   stopped: the idle-serve goodput plateau;
+3. **online phase** — start the background trainer (tight cadence)
+   and rerun the same closed loop with an ingest mix
+   (``loadgen --mix``): goodput while candidates train and promote
+   in the same process;
+4. report ``goodput_vs_idle`` (how much serving throughput background
+   training costs), the promotion count, and the measured promotion
+   latency (gate pass → new version warmed and resident).
+
+Usage: ``python tools/bench_online.py`` prints the result as one JSON
+line; ``bench.py`` imports :func:`run_bench_online` (best-effort,
+``HPNN_BENCH_NO_ONLINE=1`` skips) and ``tools/bench_gate.py`` gates
+``online_goodput_rps`` / ``online_goodput_vs_idle`` /
+``online_promote_latency_ms``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+
+def run_bench_online(*, seed: int = 11, idle_s: float = 1.2,
+                     online_s: float = 1.5, n_clients: int = 4,
+                     ingest_frac: float = 0.25) -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    tools = os.path.dirname(os.path.abspath(__file__))
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import numpy as np
+
+    import loadgen
+    from hpnn_tpu import online, serve
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve.server import make_server
+
+    n_in, n_out = 8, 2
+    k, _ = kernel_mod.generate(seed, n_in, [6], n_out)
+    osess = None
+    server = None
+    try:
+        osess = online.OnlineSession(
+            serve_kwargs=dict(max_batch=16, n_buckets=3,
+                              max_wait_ms=1.0, max_depth=128),
+            rows=32, batch=8, epochs=4, interval_s=0.05, holdout=4,
+            gate=online.Gate(margin=0.0, watch_s=5.0), seed=seed)
+        osess.add_kernel("bench", k)
+        # pre-feed: a learnable synthetic stream (targets a smooth
+        # function of the inputs) so the gate has real improvements
+        # to promote during the online phase
+        rng = np.random.RandomState(seed)
+        X = rng.uniform(0.0, 1.0, size=(192, n_in))
+        T = np.tanh(X[:, :n_out])
+        osess.feed(X, T)
+        # pay the one-time epoch-fn + eval compiles outside the
+        # measured phases (a real resident process compiles once)
+        osess.tick()
+        server = make_server(osess.serve, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        common = dict(kernels=("bench",), rows_choices=(1, 2, 4),
+                      n_in=n_in, timeout_s=2.0, max_retries=0)
+        # discarded warmup (first requests pay tracing)
+        loadgen.run_closed_loop(url, n_clients=2, duration_s=0.3,
+                                seed=seed, **common)
+        idle = loadgen.run_closed_loop(url, n_clients=n_clients,
+                                       duration_s=idle_s, seed=seed,
+                                       **common)
+        promoted_before = osess.promoter.stats["promoted"]
+        osess.start()
+        mix = loadgen.run_closed_loop(
+            url, n_clients=n_clients, duration_s=online_s,
+            seed=seed + 1, ingest_frac=ingest_frac, n_out=n_out,
+            **common)
+        osess.trainer.close()
+        promotions = (osess.promoter.stats["promoted"]
+                      - promoted_before)
+        lat_s = osess.promoter.last_promote_latency_s
+        idle_rps = idle["goodput_rps"]
+        vs_idle = (mix["goodput_rps"] / idle_rps if idle_rps
+                   else None)
+        return {
+            "metric": "online_train_while_serve",
+            "idle_goodput_rps": idle_rps,
+            "online_goodput_rps": mix["goodput_rps"],
+            "online_goodput_vs_idle": (None if vs_idle is None
+                                       else round(vs_idle, 4)),
+            "ingest_frac": ingest_frac,
+            "promotions": promotions,
+            "rollbacks": osess.promoter.stats["rollbacks"],
+            "promote_latency_ms": (None if lat_s is None
+                                   else round(lat_s * 1e3, 3)),
+            "trainer_rounds": osess.trainer.stats["rounds"],
+            "idle": idle,
+            "online": mix,
+        }
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if osess is not None:
+            osess.close()
+
+
+def main(argv=None) -> int:
+    out = run_bench_online()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,10 +40,18 @@ the request-id span attributes feed the load harness
 (tools/loadgen.py) and /healthz verdicts, so their shapes are checked
 too.
 
+And the online-learning schema lint (:func:`lint_online`): the
+``online.*`` records (hpnn_tpu/online/, docs/online.md) are the audit
+trail for *weight promotions in a live serving process* — a promote
+event with a non-monotone version, a reject without a reason, or a
+rollback that doesn't say which version it restored makes an incident
+unreconstructable, so their shapes (and the promote/rollback version
+bookkeeping) are frozen the same way the ledger rows are.
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
-        [--slo PATH]
+        [--slo PATH] [--online PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -70,7 +78,7 @@ DOC_RE = re.compile(
 )
 
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
-             "docs/fleet.md")
+             "docs/fleet.md", "docs/online.md")
 SRC_DIR = "hpnn_tpu"
 
 
@@ -486,6 +494,177 @@ def lint_slo(path: str) -> list[str]:
     return failures
 
 
+# the online-learning record contracts (hpnn_tpu/online/,
+# serve/registry.py install; docs/online.md "Event catalog")
+ONLINE_GAUGES = ("online.buffer_depth", "online.staleness_s",
+                 "online.train_loss", "online.candidate_loss",
+                 "online.resident_loss", "online.promote_latency_ms")
+ONLINE_COUNTS = ("online.ingest", "online.drop", "online.round_failed")
+REJECT_REASONS = ("sentinel", "margin", "eval")
+
+
+def _pos_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v >= 1
+
+
+def lint_online(path: str) -> list[str]:
+    """Schema-lint the online-learning records of one metrics sink.
+
+    Checks, per record:
+
+    * ``online.*`` gauges — ``kind == "gauge"``, finite ``value``;
+      depth / staleness / promote-latency gauges non-negative.
+    * ``online.ingest`` / ``online.drop`` / ``online.round_failed``
+      counts — ``kind == "count"``, positive increment ``n``.
+    * ``online.round`` — ``members``/``groups``/``rows`` ints >= 1
+      (a round event only fires when something trained), non-negative
+      int ``promoted``/``rejected``/``rolled_back`` tallies, and a
+      non-negative ``train_s``.
+    * ``online.promote`` — non-empty ``kernel``; int versions with
+      ``to_version > from_version`` (promotion always bumps); finite
+      ``cand_loss`` strictly below ``res_loss`` (the margin gate can
+      never promote a non-improvement).
+    * ``online.reject`` — non-empty ``kernel``; ``reason`` one of
+      ``sentinel`` / ``margin`` / ``eval``.
+    * ``online.rollback`` — non-empty ``kernel``; int versions with
+      ``to_version > from_version`` (rollback *re-installs*, it never
+      rewinds the version counter); int ``restored`` (the version
+      whose weights came back); non-empty ``reason``.
+    * ``serve.install`` counts — ``kind == "count"``, non-empty
+      ``kernel``, ``version`` an int >= 1.
+    * ``span.end`` records named ``online.train_round`` — ``members``
+      and ``rows`` ints >= 1, so a slow round is attributable.
+
+    A sink with no ``online.*`` records fails — this lint only makes
+    sense on a run where the online layer actually fed / trained /
+    gated.  Returns failure strings (empty = pass).
+    """
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_online = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if isinstance(ev, str) and ev.startswith("online."):
+            n_online += 1
+        if ev in ONLINE_GAUGES:
+            if rec.get("kind") != "gauge":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'gauge'")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v):
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a finite number")
+            elif ev in ("online.buffer_depth", "online.staleness_s",
+                        "online.promote_latency_ms") and v < 0:
+                failures.append(f"{at}: {ev} value {v!r} is negative")
+        elif ev in ONLINE_COUNTS:
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'count'")
+            if not _pos_int(rec.get("n")):
+                failures.append(
+                    f"{at}: {ev} increment {rec.get('n')!r} is not a "
+                    "positive int")
+        elif ev == "online.round":
+            for key in ("members", "groups", "rows"):
+                if not _pos_int(rec.get(key)):
+                    failures.append(
+                        f"{at}: online.round {key} {rec.get(key)!r} "
+                        "is not an int >= 1")
+            for key in ("promoted", "rejected", "rolled_back"):
+                v = rec.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    failures.append(
+                        f"{at}: online.round {key} {v!r} is not a "
+                        "non-negative int")
+            ts = rec.get("train_s")
+            if not _num(ts) or ts < 0:
+                failures.append(
+                    f"{at}: online.round train_s {ts!r} is not a "
+                    "non-negative number")
+        elif ev in ("online.promote", "online.rollback"):
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: {ev} kernel {k!r} is not a non-empty "
+                    "string")
+            fv, tv = rec.get("from_version"), rec.get("to_version")
+            if not _pos_int(tv) or not isinstance(fv, int) \
+                    or isinstance(fv, bool) or not tv > fv:
+                failures.append(
+                    f"{at}: {ev} versions {fv!r} -> {tv!r} do not "
+                    "bump (install always advances the counter)")
+            if ev == "online.promote":
+                cl, rl = rec.get("cand_loss"), rec.get("res_loss")
+                if not _num(cl) or not _num(rl) \
+                        or not math.isfinite(cl) or not cl < rl:
+                    failures.append(
+                        f"{at}: online.promote cand_loss {cl!r} is "
+                        f"not finitely below res_loss {rl!r}")
+            else:
+                if not isinstance(rec.get("restored"), int) \
+                        or isinstance(rec.get("restored"), bool):
+                    failures.append(
+                        f"{at}: online.rollback restored "
+                        f"{rec.get('restored')!r} is not an int")
+                r = rec.get("reason")
+                if not isinstance(r, str) or not r:
+                    failures.append(
+                        f"{at}: online.rollback reason {r!r} is not "
+                        "a non-empty string")
+        elif ev == "online.reject":
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: online.reject kernel {k!r} is not a "
+                    "non-empty string")
+            if rec.get("reason") not in REJECT_REASONS:
+                failures.append(
+                    f"{at}: online.reject reason "
+                    f"{rec.get('reason')!r} not in "
+                    f"{'/'.join(REJECT_REASONS)}")
+        elif ev == "serve.install":
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: serve.install kind {rec.get('kind')!r} "
+                    "!= 'count'")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: serve.install kernel {k!r} is not a "
+                    "non-empty string")
+            if not _pos_int(rec.get("version")):
+                failures.append(
+                    f"{at}: serve.install version "
+                    f"{rec.get('version')!r} is not an int >= 1")
+        elif ev == "span.end" and rec.get("name") == "online.train_round":
+            for key in ("members", "rows"):
+                if not _pos_int(rec.get(key)):
+                    failures.append(
+                        f"{at}: online.train_round span {key} "
+                        f"{rec.get(key)!r} is not an int >= 1")
+    if not n_online:
+        failures.append(
+            f"sink {path!r} has no online.* records — did the online "
+            "layer feed / train / gate during this run?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -508,6 +687,13 @@ def main(argv: list[str] | None = None) -> int:
             sys.stderr.write("check_obs_catalog: --slo needs a path\n")
             return 2
         failures += lint_slo(argv[i + 1])
+    if "--online" in argv:
+        i = argv.index("--online")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --online needs a "
+                             "path\n")
+            return 2
+        failures += lint_online(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -22,8 +22,11 @@ probes + sentinel + checksum ledger (``HPNN_PROBES`` /
 ``HPNN_NUMERICS`` / ``HPNN_LEDGER``), lifecycle spans + compiled-cost
 attribution (``HPNN_SPANS`` / ``HPNN_COST``), the SLO tracker
 (``HPNN_SLO_MS`` — load shedding is additionally exercised to an
-actual Shed rejection in the serve section below), and a live export
-server whose
+actual Shed rejection in the serve section below), the whole
+``HPNN_ONLINE_*`` train-while-serve knob family (inert outside
+``hpnn_tpu/online/``; a full feed → train → gate → rollback round is
+additionally exercised to silence below), and a live export server
+whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
 minimal one.  A final ledger-only run proves the probes are
@@ -146,6 +149,18 @@ def check(tmpdir: str) -> list[str]:
         finally:
             export.stop_export_server(server)
 
+    # the HPNN_ONLINE_* family (docs/online.md) is read only inside
+    # hpnn_tpu/online/ — setting it during a plain train+eval round
+    # must be inert: not a byte, not an event
+    _ONLINE_KNOBS = (("HPNN_ONLINE_BUFFER", "64"),
+                     ("HPNN_ONLINE_RESERVOIR", "8"),
+                     ("HPNN_ONLINE_HOLDOUT", "4"),
+                     ("HPNN_ONLINE_ROWS", "16"),
+                     ("HPNN_ONLINE_BATCH", "4"),
+                     ("HPNN_ONLINE_EPOCHS", "2"),
+                     ("HPNN_ONLINE_INTERVAL_S", "60"),
+                     ("HPNN_ONLINE_MARGIN", "0.0"),
+                     ("HPNN_ONLINE_WATCH_S", "5"))
     ledger_b = os.path.join(tmpdir, "ledger_b.jsonl")
     os.environ["HPNN_FLIGHT"] = os.path.join(tmpdir, "flight.jsonl")
     os.environ["HPNN_PROBES"] = "1"
@@ -154,21 +169,23 @@ def check(tmpdir: str) -> list[str]:
     os.environ["HPNN_SPANS"] = "1"
     os.environ["HPNN_COST"] = "1"
     os.environ["HPNN_SLO_MS"] = "50"
+    for knob, val in _ONLINE_KNOBS:
+        os.environ[knob] = val
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
     finally:
         for knob in ("HPNN_FLIGHT", "HPNN_PROBES", "HPNN_NUMERICS",
                      "HPNN_LEDGER", "HPNN_SPANS", "HPNN_COST",
-                     "HPNN_SLO_MS"):
+                     "HPNN_SLO_MS") + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
 
     if plain != instrumented:
         failures.append(
             "stdout is NOT byte-identical with HPNN_METRICS + "
             "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
-            "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + export server all "
-            "enabled "
+            "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + HPNN_ONLINE_* + "
+            "export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
     body = scraped.get("metrics", "")
     if "# TYPE" not in body or "hpnn_" not in body:
@@ -266,12 +283,59 @@ def check(tmpdir: str) -> list[str]:
     fleet_mod.train_fleet([k, k2], Xf, Tf, epochs=1, batch=2,
                           seeds=[1, 2])
 
+    # Train-while-serve (hpnn_tpu/online/, docs/online.md) rides the
+    # same silence contract: with the WHOLE HPNN_ONLINE_* knob family
+    # set (so the env-reading paths run, not just the defaults), feed
+    # a stream, run a synchronous training round through the promotion
+    # gate, serve a query, and roll back — not one stdout byte; the
+    # online.* audit trail lands in the sink instead.
+    from hpnn_tpu import online as online_mod
+
+    online_sink = os.path.join(tmpdir, "online.jsonl")
+    for knob, val in _ONLINE_KNOBS:
+        os.environ[knob] = val
+    online_buf = io.StringIO()
+    try:
+        obs_mod.configure(online_sink)
+        with contextlib.redirect_stdout(online_buf):
+            osess = online_mod.OnlineSession(
+                serve_kwargs=dict(max_batch=8, n_buckets=2,
+                                  max_wait_ms=1.0))
+            osess.add_kernel("lint_online", k)
+            orng = np.random.RandomState(3)
+            Xo = orng.uniform(0.0, 1.0, (48, 8))
+            osess.feed(Xo, np.tanh(Xo[:, :2]))
+            osess.tick()
+            osess.infer("lint_online", np.zeros(8))
+            osess.rollback("lint_online")
+            osess.close()
+    finally:
+        obs_mod.configure(None)
+        for knob, _ in _ONLINE_KNOBS:
+            os.environ.pop(knob, None)
+    if online_buf.getvalue():
+        failures.append(
+            "online train-while-serve round wrote stdout: "
+            f"{online_buf.getvalue()[:120]!r}")
+    with open(online_sink) as fp:
+        onames = {json.loads(ln).get("ev") for ln in fp if ln.strip()}
+    for want in ("online.ingest", "online.buffer_depth",
+                 "online.staleness_s", "online.round",
+                 "online.train_loss", "online.candidate_loss",
+                 "online.resident_loss"):
+        if want not in onames:
+            failures.append(f"online sink missing event {want!r}")
+    if not {"online.promote", "online.reject"} & onames:
+        failures.append(
+            "online sink carries neither online.promote nor "
+            "online.reject — the gate never ruled")
+
     with_serve = _run_round(os.path.join(tmpdir, "c"), None)
     if plain != with_serve:
         failures.append(
             "stdout is NOT byte-identical after importing/exercising "
-            "hpnn_tpu.serve (per-kernel + fleet) and "
-            f"train.fleet (plain {len(plain)}B vs "
+            "hpnn_tpu.serve (per-kernel + fleet), train.fleet, and "
+            f"hpnn_tpu.online (plain {len(plain)}B vs "
             f"with-serve {len(with_serve)}B)")
 
     # The zero-perturbation proof for the numerics probes: a run with

@@ -18,7 +18,11 @@ traffic does:
 
 Request mix: kernel names (``--kernels a,b``, fleet-mode servers
 coalesce same-topology kernels transparently) and row counts
-(``--rows 1,2,4``) are drawn per request.  429 responses are retried
+(``--rows 1,2,4``) are drawn per request.  ``--mix FRAC`` makes that
+fraction of requests ``POST /ingest`` sample feeds (``--n-out`` sets
+the target width), so ONE loadgen run drives the full
+train-while-serve loop against an ``online_nn`` server
+(docs/online.md).  429 responses are retried
 honoring ``Retry-After`` (capped; ``--retries 0`` records the shed
 instead), 504/timeouts are terminal per request.  The server's
 ``X-Request-Id`` is recorded per outcome, so any row in the JSONL
@@ -87,8 +91,11 @@ def summarize(records: list[dict], duration_s: float, *,
     and the latency summary of *served* requests only."""
     n = len(records)
     counts = {s: 0 for s in ("ok", "shed", "timeout", "error")}
+    ops: dict[str, int] = {}
     for r in records:
         counts[r["status"]] = counts.get(r["status"], 0) + 1
+        op = r.get("op", "infer")
+        ops[op] = ops.get(op, 0) + 1
     ok_lat_s = [r["latency_ms"] / 1e3 for r in records
                 if r["status"] == "ok"]
     goodput = counts["ok"] / duration_s if duration_s else 0.0
@@ -107,6 +114,7 @@ def summarize(records: list[dict], duration_s: float, *,
                                if offered_rps else None),
         "shed_rate": round(counts["shed"] / n, 4) if n else 0.0,
         "timeout_rate": round(counts["timeout"] / n, 4) if n else 0.0,
+        "ops": ops,
         "latency_ms": latency_summary(ok_lat_s),
     }
 
@@ -218,16 +226,18 @@ class _Client:
         raise OSError("unreachable")
 
     def request(self, kernel: str, rows: int, body: bytes, *,
-                max_retries: int = 2,
-                retry_cap_s: float = 1.0) -> dict:
+                max_retries: int = 2, retry_cap_s: float = 1.0,
+                path: str = "/v1/infer", op: str = "infer") -> dict:
         """Issue one logical request (with 429 retries); returns its
-        outcome row (latency spans all attempts, sleeps included)."""
+        outcome row (latency spans all attempts, sleeps included).
+        ``path``/``op`` route the mixed-traffic mode: infer requests
+        hit ``/v1/infer``, ingest feeds hit ``/ingest``."""
         attempts, code, req_id, status = 0, None, None, "error"
         t_start = time.perf_counter()
         while True:
             attempts += 1
             try:
-                code, headers, _data = self._post("/v1/infer", body)
+                code, headers, _data = self._post(path, body)
             except socket.timeout:
                 status, code = "timeout", None
                 break
@@ -254,6 +264,7 @@ class _Client:
         return {
             "kernel": kernel,
             "rows": rows,
+            "op": op,
             "status": status,
             "code": code,
             "latency_ms": round(
@@ -277,6 +288,25 @@ def _request_bodies(kernels, rows_choices, n_in: int,
     return bodies
 
 
+def _ingest_bodies(kernels, rows_choices, n_in: int, n_out: int,
+                   seed: int = 0) -> dict:
+    """Pre-serialized ``POST /ingest`` bodies per (kernel, rows) for
+    the ``--mix`` mode.  Sample values are drawn once per combination
+    (deterministic per seed): the online buffer just needs plausible
+    finite rows, and re-encoding per request would bottleneck the
+    generator, not the server."""
+    rng = np.random.RandomState(seed)
+    bodies = {}
+    for k in kernels:
+        for r in rows_choices:
+            X = rng.uniform(0.0, 1.0, size=(int(r), int(n_in)))
+            T = rng.uniform(0.0, 1.0, size=(int(r), int(n_out)))
+            bodies[(k, r)] = json.dumps(
+                {"kernel": k, "inputs": X.round(4).tolist(),
+                 "targets": T.round(4).tolist()}).encode()
+    return bodies
+
+
 # ------------------------------------------------------------ runners
 
 
@@ -286,18 +316,25 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
                   n_in: int = 8, timeout_s: float = 2.0,
                   max_retries: int = 2, retry_cap_s: float = 1.0,
                   n_workers: int = 16, seed: int = 0,
+                  ingest_frac: float = 0.0, n_out: int = 2,
                   out_path: str | None = None) -> dict:
     """Offered-load run: arrivals are scheduled up front and fired on
     time by a worker pool whether or not earlier requests finished.
-    Returns the summary dict (and writes the JSONL to ``out_path``)."""
+    ``ingest_frac`` of the arrivals become ``POST /ingest`` sample
+    feeds (the ``--mix`` mode).  Returns the summary dict (and writes
+    the JSONL to ``out_path``)."""
     rng = np.random.RandomState(seed)
     arrivals = make_arrivals(process, rate_rps, duration_s, rng)
     bodies = _request_bodies(kernels, rows_choices, n_in, timeout_s)
+    feed_bodies = (_ingest_bodies(kernels, rows_choices, n_in, n_out,
+                                  seed) if ingest_frac > 0 else {})
     specs: "queue.Queue[tuple]" = queue.Queue()
     for t in arrivals:
         k = kernels[int(rng.randint(len(kernels)))]
         r = int(rows_choices[int(rng.randint(len(rows_choices)))])
-        specs.put((t, k, r))
+        op = ("ingest" if ingest_frac > 0
+              and rng.uniform() < ingest_frac else "infer")
+        specs.put((t, k, r, op))
     records: list[dict] = []
     rec_lock = threading.Lock()
     t0 = time.perf_counter()
@@ -307,15 +344,22 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
         try:
             while True:
                 try:
-                    t_due, k, r = specs.get_nowait()
+                    t_due, k, r, op = specs.get_nowait()
                 except queue.Empty:
                     return
                 delay = t0 + t_due - time.perf_counter()
                 if delay > 0:
                     time.sleep(delay)
-                rec = client.request(k, r, bodies[(k, r)],
-                                     max_retries=max_retries,
-                                     retry_cap_s=retry_cap_s)
+                if op == "ingest":
+                    rec = client.request(
+                        k, r, feed_bodies[(k, r)],
+                        max_retries=max_retries,
+                        retry_cap_s=retry_cap_s,
+                        path="/ingest", op="ingest")
+                else:
+                    rec = client.request(k, r, bodies[(k, r)],
+                                         max_retries=max_retries,
+                                         retry_cap_s=retry_cap_s)
                 rec["t"] = round(t_due, 6)
                 with rec_lock:
                     records.append(rec)
@@ -341,10 +385,12 @@ def run_closed_loop(url: str, *, n_clients: int = 4,
                     kernels=("default",), rows_choices=(1,),
                     n_in: int = 8, timeout_s: float = 2.0,
                     max_retries: int = 0, retry_cap_s: float = 1.0,
-                    seed: int = 0,
+                    seed: int = 0, ingest_frac: float = 0.0,
+                    n_out: int = 2,
                     out_path: str | None = None) -> dict:
     """Saturation probe: N clients in sequential request loops for the
-    duration.  Offered load equals achieved load by construction."""
+    duration.  Offered load equals achieved load by construction.
+    ``ingest_frac`` of the requests become ``POST /ingest`` feeds."""
     records: list[dict] = []
     rec_lock = threading.Lock()
     t0 = time.perf_counter()
@@ -354,14 +400,24 @@ def run_closed_loop(url: str, *, n_clients: int = 4,
         client = _Client(url, timeout_s)
         bodies = _request_bodies(kernels, rows_choices, n_in,
                                  timeout_s)
+        feed_bodies = (_ingest_bodies(kernels, rows_choices, n_in,
+                                      n_out, seed + ci)
+                       if ingest_frac > 0 else {})
         try:
             while time.perf_counter() - t0 < duration_s:
                 k = kernels[int(rng.randint(len(kernels)))]
                 r = int(rows_choices[int(
                     rng.randint(len(rows_choices)))])
-                rec = client.request(k, r, bodies[(k, r)],
-                                     max_retries=max_retries,
-                                     retry_cap_s=retry_cap_s)
+                if ingest_frac > 0 and rng.uniform() < ingest_frac:
+                    rec = client.request(
+                        k, r, feed_bodies[(k, r)],
+                        max_retries=max_retries,
+                        retry_cap_s=retry_cap_s,
+                        path="/ingest", op="ingest")
+                else:
+                    rec = client.request(k, r, bodies[(k, r)],
+                                         max_retries=max_retries,
+                                         retry_cap_s=retry_cap_s)
                 rec["t"] = round(time.perf_counter() - t0, 6)
                 with rec_lock:
                     records.append(rec)
@@ -492,6 +548,12 @@ def main(argv=None) -> int:
                     help="comma-separated row counts to mix")
     ap.add_argument("--n-in", type=int, default=8,
                     help="input width of the target kernels")
+    ap.add_argument("--mix", type=float, default=0.0, metavar="FRAC",
+                    help="fraction of requests sent as POST /ingest "
+                         "sample feeds (train-while-serve traffic; "
+                         "needs an online_nn server)")
+    ap.add_argument("--n-out", type=int, default=2,
+                    help="target width of --mix ingest samples")
     ap.add_argument("--timeout", type=float, default=2.0,
                     help="per-request timeout_s")
     ap.add_argument("--retries", type=int, default=2,
@@ -511,10 +573,13 @@ def main(argv=None) -> int:
         ap.error("--url is required (or use --bench)")
     kernels = tuple(s for s in args.kernels.split(",") if s)
     rows = tuple(int(s) for s in args.rows.split(",") if s)
+    if not 0.0 <= args.mix <= 1.0:
+        ap.error("--mix must be in [0, 1]")
     common = dict(kernels=kernels, rows_choices=rows,
                   n_in=args.n_in, timeout_s=args.timeout,
                   max_retries=args.retries,
                   retry_cap_s=args.retry_cap, seed=args.seed,
+                  ingest_frac=args.mix, n_out=args.n_out,
                   out_path=args.out)
     if args.closed:
         summary = run_closed_loop(args.url, n_clients=args.clients,
