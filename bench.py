@@ -837,6 +837,22 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["online_error"] = repr(exc)
 
+    # Chaos drill (tools/chaos_drill.py run_bench_drill): SIGKILL a
+    # live online_nn child mid-traffic after a WAL-committed
+    # promotion, restart, and record recovery time / goodput dip /
+    # lost requests + the bitwise-restore verdict (docs/resilience.md).
+    # Spawns subprocesses and takes ~30 s — HPNN_BENCH_NO_DRILL=1
+    # skips it; best-effort like the other fold-ins.
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["drill"] = chaos_drill.run_bench_drill()
+        except Exception as exc:
+            out["drill_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -907,6 +923,12 @@ def main(argv=None) -> None:
         compact["online_promotions"] = on["promotions"]
         compact["online_promote_latency_ms"] = (
             on["promote_latency_ms"])
+    if "drill" in out and out["drill"].get("recovery_s") is not None:
+        dr = out["drill"]
+        compact["drill_recovery_s"] = dr["recovery_s"]
+        compact["drill_goodput_dip_pct"] = dr["goodput_dip_pct"]
+        compact["drill_lost_requests"] = dr["lost_requests"]
+        compact["drill_restored_bitwise"] = dr["restored_bitwise"]
     if "obs_overhead" in out:
         compact["obs_overhead_pct"] = (
             out["obs_overhead"]["paired_overhead_pct"]["median"]

@@ -20,6 +20,7 @@ stdout stays silent (token protocol); diagnostics go to stderr.
 from __future__ import annotations
 
 import sys
+import threading
 
 from hpnn_tpu import config, runtime
 from hpnn_tpu.cli import common
@@ -33,10 +34,18 @@ def build_from_conf(conf, *, host: str = "127.0.0.1", port: int = 0,
                     epochs: int | None = None,
                     margin: float | None = None,
                     stream: str | None = None, stream_n: int = 256,
-                    seed: int = 0):
+                    seed: int = 0, defer_warmup: bool = False):
     """(online_session, server) for ``conf``'s kernel — the testable
     core of ``main``.  ``stream`` pre-feeds the buffer from a demo
-    stream driver (the kernel widths must match the stream's)."""
+    stream driver (the kernel widths must match the stream's).
+
+    ``defer_warmup=True`` returns ``(osess, server, warm)`` instead:
+    the HTTP socket is bound *first* with the session marked unready
+    (``/readyz`` and the POST routes answer 503 + Retry-After), and
+    the caller runs ``warm()`` — kernel registration, promotion-WAL
+    replay, bucket warmup, stream pre-feed, then ``mark_ready`` — so
+    a restart under live traffic fails fast instead of refusing
+    connections until the compile stall ends (docs/resilience.md)."""
     from hpnn_tpu import online, serve
     from hpnn_tpu.online import streams
 
@@ -50,20 +59,29 @@ def build_from_conf(conf, *, host: str = "127.0.0.1", port: int = 0,
         interval_s=interval_s, rows=rows, batch=batch, epochs=epochs,
         gate=gate, seed=seed)
     name = conf.name or "default"
-    osess.add_kernel(name, conf.kernel, model=model)
-    if stream:
-        makers = {"mnist": streams.mnist_stream,
-                  "xrd": streams.xrd_stream}
-        maker = makers.get(stream)
-        if maker is None:
-            raise ValueError(f"unknown stream {stream!r} "
-                             "(want mnist|xrd)")
-        X, T = streams.take(maker(seed), stream_n)
-        if X.shape[1] != conf.kernel.n_inputs:
-            raise ValueError(
-                f"stream {stream!r} feeds {X.shape[1]} inputs but the "
-                f"kernel takes {conf.kernel.n_inputs}")
-        osess.feed(X, T)
+
+    def warm():
+        osess.add_kernel(name, conf.kernel, model=model)
+        if stream:
+            makers = {"mnist": streams.mnist_stream,
+                      "xrd": streams.xrd_stream}
+            maker = makers.get(stream)
+            if maker is None:
+                raise ValueError(f"unknown stream {stream!r} "
+                                 "(want mnist|xrd)")
+            X, T = streams.take(maker(seed), stream_n)
+            if X.shape[1] != conf.kernel.n_inputs:
+                raise ValueError(
+                    f"stream {stream!r} feeds {X.shape[1]} inputs but "
+                    f"the kernel takes {conf.kernel.n_inputs}")
+            osess.feed(X, T)
+        osess.serve.mark_ready()
+
+    if defer_warmup:
+        osess.serve.mark_unready("warming")
+        server = serve.make_server(osess.serve, host=host, port=port)
+        return osess, server, warm
+    warm()
     server = serve.make_server(osess.serve, host=host, port=port)
     return osess, server
 
@@ -93,8 +111,10 @@ def main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    from hpnn_tpu import serve
+
     try:
-        osess, server = build_from_conf(
+        osess, server, warm = build_from_conf(
             conf,
             host=opts.get("host", "127.0.0.1"),
             port=int(opts.get("port", 8700)),
@@ -107,6 +127,7 @@ def main(argv: list[str] | None = None) -> int:
                     else None),
             stream=opts.get("stream"),
             stream_n=int(opts.get("stream-n", 256)),
+            defer_warmup=True,
         )
     except (ValueError, OSError) as exc:
         sys.stderr.write(f"online_nn: cannot start: {exc}\n")
@@ -114,10 +135,33 @@ def main(argv: list[str] | None = None) -> int:
         return -1
     host, port = server.server_address[:2]
     sys.stderr.write(
-        f"online_nn: kernel {osess.kernels()[0]!r} resident and "
-        f"learning (window {osess.trainer.rows}, every "
-        f"{osess.trainer.interval_s}s), listening on {host}:{port}\n")
-    osess.start()
+        f"online_nn: listening on {host}:{port} (warming — /readyz "
+        "answers 503 until the bucket menu is compiled and any "
+        "promotion WAL is replayed)\n")
+    # SIGTERM → graceful drain (503 for new arrivals, in-flight
+    # flushed, obs/flight postmortem exactly once, exit 0)
+    serve.install_drain(server, osess.serve)
+    rc = {"code": 0}
+
+    def _warm():
+        # warmup off the serving thread: the socket answers (503)
+        # while buckets compile / the WAL replays; readiness flips
+        # inside warm()
+        try:
+            warm()
+        except Exception as exc:
+            sys.stderr.write(f"online_nn: cannot start: {exc}\n")
+            rc["code"] = -1
+            server.shutdown()
+            return
+        sys.stderr.write(
+            f"online_nn: kernel {osess.kernels()[0]!r} resident and "
+            f"learning (window {osess.trainer.rows}, every "
+            f"{osess.trainer.interval_s}s), ready on {host}:{port}\n")
+        osess.start()
+
+    threading.Thread(target=_warm, daemon=True,
+                     name="hpnn-online-warm").start()
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -126,7 +170,7 @@ def main(argv: list[str] | None = None) -> int:
         server.server_close()
         osess.close()
         runtime.deinit_all()
-    return 0
+    return rc["code"]
 
 
 if __name__ == "__main__":

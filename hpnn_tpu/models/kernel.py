@@ -125,7 +125,16 @@ def validate(kernel: Kernel) -> bool:
 
 
 def load(path: str) -> tuple[str, Kernel]:
-    name, ws = kernel_format.load_kernel(path)
+    # Checkpoint files (binary, bitwise — fileio/checkpoint.py) are
+    # self-identifying; everything else is the reference text grammar.
+    # One loader means the serve registry's load/hot-reload path works
+    # on a promotion checkpoint exactly as on a kernel file.
+    from hpnn_tpu.fileio import checkpoint
+
+    if checkpoint.is_checkpoint(path):
+        name, ws, _ = checkpoint.load_checkpoint(path)
+    else:
+        name, ws = kernel_format.load_kernel(path)
     k = Kernel(tuple(ws))
     if not validate(k):
         raise kernel_format.KernelFormatError(f"inconsistent kernel file {path}")

@@ -424,11 +424,23 @@ def flush() -> None:
             st.fp.flush()
 
 
+# Signal-path teardown runs at most once per process, whichever handler
+# gets there first — the chained obs signal handler or the serve drain
+# handler (serve/server.py:install_drain).  Without the guard a drain
+# chained behind the obs handler would dump the flight ring twice.
+_signal_flushed = False
+
+
 def _crash_flush(ev: str, detail: str, reason: str) -> None:
     """Shared teardown for signals and unhandled exceptions: one marker
     event, a final summary line, sink flush, flight dump.  Must never
     raise — it runs inside handlers on already-dying processes."""
+    global _signal_flushed
     try:
+        if reason in ("signal", "drain"):
+            if _signal_flushed:
+                return
+            _signal_flushed = True
         if not isinstance(_state, _State):
             return
         event(ev, reason=detail)
@@ -495,11 +507,12 @@ def _reset_for_tests() -> None:
     re-reads ``HPNN_METRICS``.  Also forgets the flight-recorder memo
     and any file-less activation.  Test-only — production code
     re-points the sink through :func:`configure`."""
-    global _state, _memory_requested
+    global _state, _memory_requested, _signal_flushed
     with _state_lock:
         st = _state
         _state = None
         _memory_requested = False
+        _signal_flushed = False
         if isinstance(st, _State) and st.fp is not None:
             try:
                 st.fp.close()
@@ -507,10 +520,11 @@ def _reset_for_tests() -> None:
                 pass
     flight._reset_for_tests()
     # chain the sibling memos; sys.modules.get avoids import cycles
-    # (export/ledger/probes all import registry)
+    # (export/ledger/probes all import registry; chaos/wal import obs)
     for name in ("hpnn_tpu.obs.export", "hpnn_tpu.obs.ledger",
                  "hpnn_tpu.obs.probes", "hpnn_tpu.obs.cost",
-                 "hpnn_tpu.obs.spans", "hpnn_tpu.obs.slo"):
+                 "hpnn_tpu.obs.spans", "hpnn_tpu.obs.slo",
+                 "hpnn_tpu.chaos", "hpnn_tpu.online.wal"):
         mod = sys.modules.get(name)
         if mod is not None:
             mod._reset_for_tests()

@@ -18,6 +18,11 @@ without jax:
   facade wiring all of it onto a ``serve.Session``.
 * :mod:`~hpnn_tpu.online.streams` — the demo stream drivers
   (MNIST-stream, synthetic XRD-stream).
+* :mod:`~hpnn_tpu.online.wal` — crash-safe promotion durability: the
+  append-only promotion WAL + atomic bitwise weight checkpoints
+  (``HPNN_WAL_DIR``), replayed by ``OnlineSession.add_kernel`` so a
+  restarted process resumes the last promoted weights
+  (docs/resilience.md).
 
 Knobs (``HPNN_ONLINE_*``) are read once at construction time and
 nothing outside this package touches them — an unset knob costs
@@ -29,6 +34,7 @@ from hpnn_tpu.online.ingest import SampleBuffer
 from hpnn_tpu.online.promote import Gate, Promoter, eval_loss
 from hpnn_tpu.online.session import OnlineSession
 from hpnn_tpu.online.trainer import OnlineTrainer
+from hpnn_tpu.online.wal import PromotionWAL
 
 __all__ = [
     "SampleBuffer",
@@ -37,4 +43,5 @@ __all__ = [
     "eval_loss",
     "OnlineSession",
     "OnlineTrainer",
+    "PromotionWAL",
 ]

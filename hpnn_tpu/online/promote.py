@@ -23,6 +23,14 @@ promotion SLO breach or serve-side numerics regression inside the
 answers are bitwise-identical to the pre-promotion version (the
 parity-mode closure maths over the exact same host arrays).
 
+Durability: with a :class:`~hpnn_tpu.online.wal.PromotionWAL` attached
+(``HPNN_WAL_DIR``), every successful install — promotion or rollback —
+is committed checkpoint-first to the WAL (``online.checkpoint``
+event), so a killed process resumes the last promoted weights bitwise
+(docs/resilience.md).  A durability failure is counted
+(``online.checkpoint_failed``), never raised: losing persistence must
+not take down the serving process.
+
 Events: ``online.promote`` / ``online.reject`` / ``online.rollback``;
 gauges ``online.candidate_loss`` / ``online.resident_loss`` /
 ``online.promote_latency_ms``.  Catalog: docs/online.md.
@@ -30,12 +38,13 @@ gauges ``online.candidate_loss`` / ``online.resident_loss`` /
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 
 import numpy as np
 
-from hpnn_tpu import obs
+from hpnn_tpu import chaos, obs
 from hpnn_tpu.models import kernel as kernel_mod
 from hpnn_tpu.online.ingest import _env_float
 from hpnn_tpu.obs.probes import NumericsError
@@ -100,9 +109,10 @@ class Promoter:
     regression watch."""
 
     def __init__(self, session, *, gate: Gate | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, wal=None):
         self.session = session
         self.gate = gate or Gate()
+        self.wal = wal                # PromotionWAL | None (no disk)
         self._clock = clock
         self._lock = threading.Lock()
         self._prior: dict[str, object] = {}    # name -> prior Entry
@@ -161,6 +171,7 @@ class Promoter:
                                 cand_loss=cand_loss, res_loss=res_loss)
 
         # both gates passed: atomic in-memory promotion
+        chaos.inject("online.promote")  # seam: pre-install failure
         t0 = self._clock()
         entry = self.session.install_kernel(
             name, kernel_mod.Kernel(weights=ws))
@@ -177,7 +188,31 @@ class Promoter:
                   res_loss=res_loss, install_s=round(dt, 6))
         obs.gauge("online.promote_latency_ms", round(dt * 1e3, 3),
                   kernel=name)
+        self._persist(name, entry, reason="promote", step=step)
         return "promoted"
+
+    def _persist(self, name: str, entry, *, reason: str,
+                 step: int = 0) -> None:
+        """Commit ``entry`` to the promotion WAL (checkpoint first,
+        fsync'd log record second).  Best-effort by design: a full
+        disk must not fail the promotion that already happened."""
+        if self.wal is None:
+            return
+        chaos.inject("online.checkpoint")  # seam: mid-commit crash
+        try:
+            rec = self.wal.commit(name, entry.kernel.weights,
+                                  version=entry.version,
+                                  model=entry.model, reason=reason,
+                                  step=step)
+        except Exception as exc:
+            obs.count("online.checkpoint_failed", kernel=name,
+                      reason=type(exc).__name__)
+            print(f"hpnn online: WAL commit failed for {name!r}: "
+                  f"{exc!r}", file=sys.stderr)
+            return
+        obs.event("online.checkpoint", kernel=name,
+                  version=entry.version, reason=reason,
+                  ckpt=rec["ckpt"])
 
     # ---------------------------------------------------------- rollback
     def rollback(self, name: str, *, reason: str = "manual"):
@@ -197,6 +232,7 @@ class Promoter:
                   from_version=current.version,
                   to_version=entry.version,
                   restored=prior.version, reason=reason)
+        self._persist(name, entry, reason=f"rollback:{reason}")
         return entry
 
     def watching(self, name: str) -> bool:

@@ -28,13 +28,18 @@ Tests drive the loop deterministically: ``start=False`` (default) and
 
 from __future__ import annotations
 
+import hashlib
+import os
 import time
 
 import numpy as np
 
+from hpnn_tpu import obs
+from hpnn_tpu.models import kernel as kernel_mod
 from hpnn_tpu.online.ingest import SampleBuffer
 from hpnn_tpu.online.promote import Gate, Promoter
 from hpnn_tpu.online.trainer import OnlineTrainer
+from hpnn_tpu.online import wal as wal_mod
 
 
 class OnlineSession:
@@ -56,7 +61,7 @@ class OnlineSession:
                  interval_s: float | None = None,
                  momentum: bool = False, replay_frac: float = 0.25,
                  seed: int = 0, clock=time.monotonic,
-                 start: bool = False):
+                 start: bool = False, wal=None):
         from hpnn_tpu import serve
 
         self._own_serve = session is None
@@ -65,7 +70,12 @@ class OnlineSession:
                                    reservoir=reservoir,
                                    holdout=holdout, clock=clock,
                                    seed=seed)
-        self.promoter = Promoter(self.serve, gate=gate, clock=clock)
+        # promotion durability (online/wal.py): explicit wal= wins,
+        # else the HPNN_WAL_DIR knob, else None (no disk, no cost)
+        self.wal = wal if wal is not None else wal_mod.from_env()
+        self.restored: dict[str, int] = {}  # name -> WAL version
+        self.promoter = Promoter(self.serve, gate=gate, clock=clock,
+                                 wal=self.wal)
         self.trainer = OnlineTrainer(
             self.buffer, self.serve, self.promoter, rows=rows,
             batch=batch, epochs=epochs, interval_s=interval_s,
@@ -86,9 +96,35 @@ class OnlineSession:
     def add_kernel(self, name: str, kernel, *, model: str = "ann",
                    warmup: bool = True):
         """Register ``kernel`` in the serve registry AND track it for
-        online training/promotion."""
-        entry = self.serve.register_kernel(name, kernel, model=model,
-                                           warmup=warmup)
+        online training/promotion.
+
+        With a promotion WAL attached, the WAL is replayed first: when
+        it holds a committed version of ``name``, *those* weights (the
+        last promoted before the previous process died) are installed
+        instead of the caller's — bitwise, from the checkpoint — and
+        the entry carries the checkpoint's path + ``(st_mtime_ns,
+        st_size)`` signature so the registry's hot-reload staleness
+        machinery treats it like any file-backed kernel."""
+        restored = (self.wal.restore(name)
+                    if self.wal is not None else None)
+        if restored is not None:
+            ws, rec = restored
+            ckpt = os.path.join(self.wal.dir, rec["ckpt"])
+            st = os.stat(ckpt)
+            entry = self.serve.registry.register(
+                name, kernel_mod.Kernel(weights=ws),
+                model=rec.get("model", model), path=ckpt,
+                mtime=st.st_mtime, sig=(st.st_mtime_ns, st.st_size))
+            if warmup:
+                self.serve.engine.warmup([name])
+            self.restored[name] = int(rec.get("version", 0))
+            obs.event("online.restore", kernel=name,
+                      wal_version=int(rec.get("version", 0)),
+                      version=entry.version, ckpt=rec["ckpt"])
+        else:
+            entry = self.serve.register_kernel(name, kernel,
+                                               model=model,
+                                               warmup=warmup)
         self.trainer.track(name)
         return entry
 
@@ -133,11 +169,18 @@ class OnlineSession:
             entry = self.serve.registry.get(name)
             doc = {"version": entry.version,
                    "watch": self.promoter.watching(name)}
+            # bitwise identity of the resident weights — the handle
+            # the chaos drills use to prove restart == resume
+            sha = hashlib.sha256()
+            for w in entry.kernel.weights:
+                sha.update(np.ascontiguousarray(np.asarray(w))
+                           .tobytes())
+            doc["weights_sha"] = sha.hexdigest()[:16]
             losses = self.promoter.last_losses.get(name)
             if losses is not None:
                 doc["candidate_loss"], doc["resident_loss"] = losses
             kernels[name] = doc
-        return {
+        out = {
             "buffer": {
                 "depth": self.buffer.depth(),
                 "capacity": self.buffer.capacity,
@@ -154,6 +197,10 @@ class OnlineSession:
             "promoter": dict(self.promoter.stats),
             "kernels": kernels,
         }
+        if self.wal is not None:
+            out["wal"] = dict(self.wal.doc(),
+                              restored=dict(self.restored))
+        return out
 
     # ------------------------------------------------------------- close
     def close(self) -> None:

@@ -35,7 +35,7 @@ import time
 
 import numpy as np
 
-from hpnn_tpu import obs
+from hpnn_tpu import chaos, obs
 from hpnn_tpu.online.ingest import _env_float, _env_int
 
 
@@ -197,6 +197,11 @@ class OnlineTrainer:
                     else self.buffer.eval_snapshot())
         for name, (cand, loss) in candidates.items():
             obs.gauge("online.train_loss", loss, kernel=name)
+            # seam: nan@train.round corrupts the candidate (the gate
+            # must reject it); raise/kill/delay also land here
+            corrupted = chaos.inject("train.round", arrays=cand)
+            if corrupted is not None:
+                cand = corrupted
             if self.candidate_hook is not None:
                 hooked = self.candidate_hook(name, cand)
                 if hooked is not None:

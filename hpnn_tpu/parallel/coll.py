@@ -30,6 +30,19 @@ from jax import lax
 
 from hpnn_tpu import obs
 
+# jax.shard_map only became a top-level API after the 0.4 series; on
+# older installs the same function lives in jax.experimental under the
+# old keyword spelling (check_rep, later renamed check_vma).  The TP/DP
+# trainers import it from here so they run on both.
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - depends on jax version
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
 
 def _census(name: str, axis, x, **fields) -> None:
     if not obs.enabled():

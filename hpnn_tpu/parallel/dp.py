@@ -196,7 +196,7 @@ def make_dp_train_step(mesh, *, model: str = "ann", momentum: bool = False,
 
     rep = P()
     batch = P(DATA_AXIS)
-    sharded = jax.shard_map(
+    sharded = coll.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(rep, rep, batch, batch),

@@ -207,7 +207,7 @@ def make_train_fn(
         n_out=n_out,
         k=k,
     )
-    sharded = jax.shard_map(
+    sharded = coll.shard_map(
         fn,
         mesh=mesh,
         in_specs=(wspec, dspec, vec, vec, scal, scal),
@@ -270,7 +270,7 @@ def make_train_epoch_fn(
         with jax.named_scope("hpnn.tp_epoch"):
             return lax.scan(body, weights_loc, (X, T))
 
-    sharded = jax.shard_map(
+    sharded = coll.shard_map(
         epoch,
         mesh=mesh,
         in_specs=(wspec, dspec, mat, mat, scal, scal),
@@ -288,7 +288,7 @@ def make_run_fn(mesh, n_layers: int, *, model: str = "ann", n_out: int):
     def f(weights_loc, x):
         return forward_local(weights_loc, x, model=model, n_out=n_out)[-1]
 
-    sharded = jax.shard_map(
+    sharded = coll.shard_map(
         f, mesh=mesh, in_specs=(wspec, rep), out_specs=rep, check_vma=False
     )
     return jax.jit(sharded)
@@ -310,7 +310,7 @@ def make_batched_run_fn(mesh, n_layers: int, *, model: str = "ann",
         )[-1]
         return jax.vmap(fwd)(X)
 
-    sharded = jax.shard_map(
+    sharded = coll.shard_map(
         f, mesh=mesh, in_specs=(wspec, rep), out_specs=rep, check_vma=False
     )
 

@@ -25,7 +25,7 @@ and semantics: docs/serving.md.
 from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull, Shed
 from hpnn_tpu.serve.engine import Engine, bucket_for, bucket_menu
 from hpnn_tpu.serve.registry import Entry, Registry, RegistryError
-from hpnn_tpu.serve.server import Session, make_server
+from hpnn_tpu.serve.server import Session, install_drain, make_server
 
 __all__ = [
     "Batcher",
@@ -39,5 +39,6 @@ __all__ = [
     "Registry",
     "RegistryError",
     "Session",
+    "install_drain",
     "make_server",
 ]

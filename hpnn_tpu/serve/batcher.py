@@ -47,7 +47,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Sequence
 
-from hpnn_tpu import obs
+from hpnn_tpu import chaos, obs
 
 
 class QueueFull(RuntimeError):
@@ -189,6 +189,7 @@ class Batcher:
         one request's breakdown."""
         if rows < 1:
             raise ValueError("rows must be >= 1")
+        chaos.inject("batcher.submit")  # seam: admission (pre-lock)
         now = self._clock()
         req = _Request(payload, int(rows), now + float(timeout_s), now,
                        span=span, req_id=req_id)
@@ -343,6 +344,7 @@ class Batcher:
                                 rows=sum(r.rows for r in batch),
                                 requests=len(batch))
         try:
+            chaos.inject("batcher.drain")  # seam: fails just this batch
             results = self._dispatch([r.payload for r in batch])
             if len(results) != len(batch):
                 raise RuntimeError(

@@ -53,7 +53,7 @@ import time
 
 import numpy as np
 
-from hpnn_tpu import obs
+from hpnn_tpu import chaos, obs
 from hpnn_tpu.serve.registry import Entry, Registry
 
 DEFAULT_MAX_BATCH = 64
@@ -324,6 +324,7 @@ class Engine:
         """Batcher dispatch hook: concatenate the payload row blocks,
         run them through one (or a few) bucket dispatches, split the
         results back per payload."""
+        chaos.inject("serve.dispatch")  # seam: device dispatch
         entry = self.registry.get(entry_name)
         blocks = [np.atleast_2d(np.asarray(p)) for p in payloads]
         for b in blocks:

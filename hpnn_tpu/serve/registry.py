@@ -21,7 +21,7 @@ import os
 import threading
 from typing import NamedTuple
 
-from hpnn_tpu import obs
+from hpnn_tpu import chaos, obs
 from hpnn_tpu.models import kernel as kernel_mod
 
 
@@ -156,6 +156,7 @@ class Registry:
             raise RegistryError(
                 f"kernel {name!r} was registered from memory; "
                 "nothing to reload")
+        chaos.inject("registry.reload")  # seam: forced reload
         new = self.load(name, entry.path, model=entry.model)
         obs.count("serve.reload", kernel=name, version=new.version)
         return new
@@ -184,6 +185,10 @@ class Registry:
         elif entry.mtime is not None and st.st_mtime == entry.mtime:
             return False  # pre-sig entry (registered with mtime only)
         try:
+            # seam inside the guard: an injected fault degrades to a
+            # counted failed probe, resident version kept — the same
+            # contract as a torn file overwrite
+            chaos.inject("registry.reload")
             self.load(name, entry.path, model=entry.model)
         except Exception:
             obs.count("serve.reload_failed", kernel=name, reason="load")

@@ -22,11 +22,23 @@ same Session, for drivers that aren't Python:
   ``{"kernel": n?, "inputs": [...], "targets": [...]}`` → feed the
   online-learning sample buffer when an ``OnlineSession`` is attached
   (hpnn_tpu/online/; docs/online.md); 404 on a plain serving process.
-* ``GET /healthz`` → kernel/bucket census, bucket-compile count,
-  per-kernel queue depth + oldest-waiter age + shed/expired
-  counters, SLO verdict, process obs health.
+* ``GET /healthz`` → **liveness**: always 200 while the process can
+  answer — kernel/bucket census, bucket-compile count, per-kernel
+  queue depth + oldest-waiter age + shed/expired counters, SLO
+  verdict, process obs health, plus the readiness verdict.
+* ``GET /readyz`` → **readiness**: 200 once the session is warm, 503
+  + ``Retry-After`` while buckets are pre-warming or the promotion
+  WAL is replaying (``Session.mark_unready``) — and the POST routes
+  answer the same 503 so restart-under-traffic fails fast instead of
+  timing out (docs/resilience.md).
 * ``GET /metrics`` → the obs aggregate snapshot in Prometheus text
   format (obs/export.py; docs/observability.md).
+
+SIGTERM graceful drain: :func:`install_drain` chains a handler that
+stops admission (readiness flips, new arrivals get 503 +
+``Retry-After``), flushes in-flight batches, flushes the obs sink and
+flight recorder exactly once (shared guard with the obs crash
+handlers), and lets the driver exit 0 (docs/resilience.md).
 
 Nothing here writes stdout (request logging is suppressed; errors go
 to stderr) — the token protocol stays byte-frozen even when a server
@@ -39,6 +51,7 @@ import itertools
 import json
 import math
 import os
+import signal
 import sys
 import threading
 import time
@@ -101,6 +114,12 @@ class Session:
         # Both stay None on a plain serving process (route answers 404)
         self.ingest_hook = None
         self.online_health = None
+        # readiness (distinct from liveness): a session is born ready
+        # for the embed-and-go paths; drivers that bind the HTTP edge
+        # before warmup/WAL-replay flip it with mark_unready/mark_ready
+        # so restart-under-traffic answers 503 instead of hanging
+        self._ready = True
+        self._ready_reason: str | None = None
 
     # ------------------------------------------------------------ kernels
     def load_kernel(self, name: str, path: str, *,
@@ -154,6 +173,27 @@ class Session:
     def kernels(self) -> list[str]:
         return self.registry.names()
 
+    # ------------------------------------------------------------ readiness
+    def mark_unready(self, reason: str) -> None:
+        """Flip the readiness verdict (liveness unaffected): the HTTP
+        edge answers 503 + Retry-After on /readyz and the POST routes
+        until :meth:`mark_ready`.  Used around warmup / promotion-WAL
+        replay at boot and by the SIGTERM drain."""
+        self._ready = False
+        self._ready_reason = str(reason)
+        obs.event("serve.unready", reason=str(reason))
+
+    def mark_ready(self) -> None:
+        self._ready = True
+        self._ready_reason = None
+        obs.event("serve.ready")
+
+    def is_ready(self) -> bool:
+        return self._ready
+
+    def ready_doc(self) -> dict:
+        return {"ready": self._ready, "reason": self._ready_reason}
+
     def health(self) -> dict:
         """The /healthz document: kernel census, bucket-compile census,
         per-batcher queue depth + oldest-waiter age + cumulative
@@ -162,6 +202,9 @@ class Session:
             batchers = dict(self._batchers)
         doc = {
             "status": "ok",
+            "live": True,
+            "ready": self._ready,
+            "ready_reason": self._ready_reason,
             "kernels": self.registry.names(),
             "buckets": list(self.engine.buckets),
             "compiled": self.engine.compiled_count(),
@@ -320,9 +363,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _not_ready(self) -> bool:
+        """503 + Retry-After when the session is not (yet, or no
+        longer) accepting work — boot warmup, WAL replay, drain."""
+        if self.session.is_ready():
+            return False
+        doc = self.session.ready_doc()
+        doc.update(error="not ready", retriable=True)
+        self._reply(503, doc, headers={"Retry-After": "1"})
+        return True
+
     def do_GET(self):
         if self.path == "/healthz":
             self._reply(200, self.session.health())
+        elif self.path == "/readyz":
+            if not self._not_ready():
+                self._reply(200, self.session.ready_doc())
         elif self.path == "/metrics":
             body = obs.export.metrics_body()
             self.send_response(200)
@@ -357,6 +413,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"no such path {self.path}"})
 
     def _infer(self, req: dict):
+        if self._not_ready():
+            return
         name = req.get("kernel", "default")
         try:
             inputs = np.asarray(req.get("inputs"), dtype=np.float64)
@@ -408,6 +466,8 @@ class _Handler(BaseHTTPRequestHandler):
         Feeds the online-learning sample buffer; 404 when no online
         session is attached (plain serving process) or the kernel is
         unknown, 400 on malformed/width-mismatched samples."""
+        if self._not_ready():
+            return
         hook = self.session.ingest_hook
         if hook is None:
             self._reply(404, {"error": "online ingest not enabled"})
@@ -444,6 +504,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown kernel {name!r}"})
         except RegistryError as exc:
             self._reply(400, {"error": str(exc)})
+        except Exception as exc:
+            # a reload that blew up mid-flight (chaos raise@
+            # registry.reload included) keeps the resident version —
+            # report it as a server-side failure, not a hung socket
+            self._reply(500, {"error": f"reload failed: {exc!r}",
+                              "retriable": True})
         else:
             self._reply(200, {"kernel": name,
                               "version": entry.version})
@@ -461,3 +527,48 @@ def make_server(session: Session, host: str = "127.0.0.1",
     obs.event("serve.listen", host=host,
               port=server.server_address[1])
     return server
+
+
+def install_drain(server: ThreadingHTTPServer, session: Session):
+    """Install the SIGTERM graceful-drain handler (main thread only;
+    a no-op elsewhere).  On SIGTERM, exactly once:
+
+    1. readiness flips to ``draining`` — new arrivals get 503 +
+       ``Retry-After`` while in-flight requests keep their sockets;
+    2. the session closes: every queued request is drained through
+       dispatch (or completed with an error), batcher threads join;
+    3. the obs sink is summarized + flushed and the flight recorder
+       dumped **exactly once** even though the obs crash handlers
+       chain the same signal — both paths share
+       ``obs.registry._crash_flush``'s signal-path guard, so whichever
+       handler runs first does the postmortem and the other skips it
+       (the satellite-3 fix; docs/resilience.md);
+    4. ``server.shutdown()`` runs on a helper thread (calling it from
+       the handler would deadlock a main-thread ``serve_forever``), so
+       the driver's ``serve_forever`` returns and it exits 0.
+
+    Returns the handler (tests invoke it directly)."""
+    from hpnn_tpu.obs import registry as obs_registry
+
+    done = threading.Event()
+
+    def _drain(signum=signal.SIGTERM, frame=None):
+        if done.is_set():
+            return
+        done.set()
+        session.mark_unready("draining")
+        obs.event("serve.drain", signal=int(signum))
+        try:
+            session.close()
+        except Exception as exc:  # drain must finish no matter what
+            sys.stderr.write(f"serve: drain close failed: {exc!r}\n")
+        obs_registry._crash_flush("obs.signal", "SIGTERM", "drain")
+        threading.Thread(target=server.shutdown, daemon=True,
+                         name="hpnn-drain-shutdown").start()
+
+    if threading.current_thread() is threading.main_thread():
+        try:
+            signal.signal(signal.SIGTERM, _drain)
+        except (ValueError, OSError):
+            pass
+    return _drain

@@ -1,0 +1,173 @@
+"""Deterministic fault injection (hpnn_tpu/chaos/, docs/resilience.md).
+
+Covers the ``HPNN_CHAOS`` grammar (terms, parameter continuation,
+malformed-term degradation), the unset fast path, each action's
+behavior at a seam (raise / delay / nan, with ``after``/``times``
+budgets), seeded determinism of probabilistic plans, the
+``chaos.inject`` audit count, and the memo-reset chain from
+``obs.registry._reset_for_tests``.
+"""
+
+import contextlib
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import chaos, obs
+from hpnn_tpu.online import wal as wal_mod
+
+
+@contextlib.contextmanager
+def _armed(plan, seed=None):
+    os.environ["HPNN_CHAOS"] = plan
+    if seed is not None:
+        os.environ["HPNN_CHAOS_SEED"] = str(seed)
+    chaos._reset_for_tests()
+    try:
+        yield
+    finally:
+        os.environ.pop("HPNN_CHAOS", None)
+        os.environ.pop("HPNN_CHAOS_SEED", None)
+        chaos._reset_for_tests()
+
+
+def test_unset_is_disarmed_and_memoized():
+    os.environ.pop("HPNN_CHAOS", None)
+    chaos._reset_for_tests()
+    try:
+        assert not chaos.enabled()
+        assert chaos.inject("serve.dispatch") is None
+        # the verdict is memoized: arming the env AFTER the first read
+        # must not change a running process (plans are parsed once)
+        os.environ["HPNN_CHAOS"] = "raise@serve.dispatch"
+        assert chaos.inject("serve.dispatch") is None
+        assert chaos.plan_doc() == []
+    finally:
+        os.environ.pop("HPNN_CHAOS", None)
+        chaos._reset_for_tests()
+
+
+def test_grammar_parameter_continuation_and_both_separators():
+    # the comma inside "ms=5,after=2" is a parameter continuation
+    # (no '@'), the semicolon starts a fresh term — one plan, two faults
+    with _armed("delay@a.b:ms=5,after=2;raise@c.d:times=3"):
+        doc = {d["seam"]: d for d in chaos.plan_doc()}
+        assert set(doc) == {"a.b", "c.d"}
+        assert doc["a.b"]["action"] == "delay"
+        assert doc["a.b"]["ms"] == 5.0
+        assert doc["a.b"]["after"] == 2
+        assert doc["c.d"]["action"] == "raise"
+        assert doc["c.d"]["times"] == 3
+
+
+def test_malformed_terms_degrade_to_no_fault(capfd):
+    # unknown action, empty seam, unknown parameter: each skipped with
+    # a stderr warning; the well-formed term still arms
+    with _armed("explode@a.b,raise@,delay@x.y:volume=11,raise@c.d"):
+        assert chaos.enabled()
+        assert [d["seam"] for d in chaos.plan_doc()] == ["c.d"]
+        with pytest.raises(chaos.ChaosFault):
+            chaos.inject("c.d")
+    err = capfd.readouterr().err
+    assert err.count("ignoring malformed term") == 3
+
+
+def test_entirely_malformed_plan_disarms(capfd):
+    with _armed("garbage"):
+        assert not chaos.enabled()
+        assert chaos.inject("anything") is None
+    assert "ignoring malformed term" in capfd.readouterr().err
+
+
+def test_raise_fires_only_at_its_seam():
+    with _armed("raise@batcher.submit"):
+        assert chaos.inject("serve.dispatch") is None
+        assert chaos.inject("batcher.drain", arrays=(np.ones(2),)) is None
+        with pytest.raises(chaos.ChaosFault):
+            chaos.inject("batcher.submit")
+
+
+def test_after_skips_then_times_caps():
+    with _armed("raise@s.m:after=2,times=1"):
+        assert chaos.inject("s.m") is None  # call 1: skipped
+        assert chaos.inject("s.m") is None  # call 2: skipped
+        with pytest.raises(chaos.ChaosFault):
+            chaos.inject("s.m")             # call 3: fires
+        assert chaos.inject("s.m") is None  # budget spent
+        doc = chaos.plan_doc()[0]
+        assert (doc["calls"], doc["fired"]) == (4, 1)
+
+
+def test_nan_corrupts_a_copy_not_the_originals():
+    with _armed("nan@train.round:times=1"):
+        a, b = np.ones(3), np.ones((2, 2))
+        out = chaos.inject("train.round", arrays=(a, b))
+        assert isinstance(out, tuple) and len(out) == 2
+        assert np.isnan(out[0][0]) and np.isfinite(out[0][1:]).all()
+        assert np.isfinite(out[1]).all()
+        # the caller's arrays are untouched — the seam substitutes
+        assert np.isfinite(a).all() and np.isfinite(b).all()
+        # times=1: the second candidate passes clean
+        assert chaos.inject("train.round", arrays=(a, b)) is None
+
+
+def test_delay_sleeps_the_configured_ms():
+    with _armed("delay@s.m:ms=30"):
+        t0 = time.perf_counter()
+        assert chaos.inject("s.m") is None
+        assert time.perf_counter() - t0 >= 0.02
+
+
+def test_probabilistic_plan_replays_identically(capfd):
+    def pattern():
+        fired = []
+        for _ in range(24):
+            try:
+                chaos.inject("s.m")
+                fired.append(0)
+            except chaos.ChaosFault:
+                fired.append(1)
+        return fired
+
+    with _armed("raise@s.m:p=0.5", seed=3):
+        first = pattern()
+    with _armed("raise@s.m:p=0.5", seed=3):
+        assert pattern() == first
+    with _armed("raise@s.m:p=0.5", seed=4):
+        other = pattern()
+    assert 0 < sum(first) < 24  # actually probabilistic
+    assert other != first       # and actually seeded
+    capfd.readouterr()  # swallow the firing lines
+
+
+def test_fire_emits_audit_count_and_stderr(tmp_path, capfd):
+    sink = str(tmp_path / "sink.jsonl")
+    obs.configure(sink)
+    try:
+        with _armed("raise@serve.dispatch"):
+            with pytest.raises(chaos.ChaosFault):
+                chaos.inject("serve.dispatch")
+    finally:
+        obs.configure(None)
+    with open(sink) as fp:
+        recs = [json.loads(ln) for ln in fp if ln.strip()]
+    hits = [r for r in recs if r.get("ev") == "chaos.inject"]
+    assert len(hits) == 1
+    assert hits[0]["seam"] == "serve.dispatch"
+    assert hits[0]["action"] == "raise"
+    assert "raise@serve.dispatch firing" in capfd.readouterr().err
+
+
+def test_obs_reset_chains_the_chaos_and_wal_memos():
+    from hpnn_tpu.obs import registry as obs_registry
+
+    with _armed("raise@s.m"):
+        assert chaos.enabled()
+        wal_mod.from_env()  # memoize the (disarmed) WAL verdict too
+        assert wal_mod._wal is not None
+        obs_registry._reset_for_tests()
+        assert chaos._plan is None
+        assert wal_mod._wal is None

@@ -251,3 +251,92 @@ def test_call_site_regex_matches_every_emitter_style(tmp_path):
     (tmp_path / "docs" / "serving.md").write_text("\n")
     emitted = mod.emitted_names(str(tmp_path))
     assert set(emitted) == {"a.timer", "b.bare", "c.raw"}
+
+
+def _chaos_rows():
+    return [
+        {"ev": "chaos.inject", "kind": "count", "n": 2,
+         "seam": "serve.dispatch", "action": "kill"},
+        {"ev": "wal.commit", "ts": 1.0, "kernel": "k", "version": 3,
+         "model": "ann", "reason": "promote", "step": 9,
+         "ckpt": "k.v3.ckpt", "sig": [171717, 424242]},
+        {"ev": "wal.skip", "kind": "count", "n": 1, "kernel": "k",
+         "reason": "torn"},
+        {"ev": "online.checkpoint", "kind": "event", "kernel": "k",
+         "version": 3, "reason": "promote", "ckpt": "k.v3.ckpt"},
+        {"ev": "online.restore", "kind": "event", "kernel": "k",
+         "wal_version": 3, "version": 1, "ckpt": "k.v3.ckpt"},
+        {"ev": "online.checkpoint_failed", "kind": "count", "n": 1,
+         "kernel": "k", "reason": "OSError"},
+        {"ev": "serve.unready", "kind": "event", "reason": "warming"},
+        {"ev": "serve.drain", "kind": "event", "signal": 15},
+        {"ev": "drill.kill9", "ok": True, "restored_bitwise": True,
+         "recovery_s": 1.4, "lost": 3, "requests": 120},
+        {"ev": "drill.sentinel", "ok": True, "lost": 0,
+         "requests": 75},
+    ]
+
+
+def test_chaos_lint_accepts_a_well_formed_trail(tmp_path):
+    mod = _load()
+    path = tmp_path / "trail.jsonl"
+    _write_sink(path, _chaos_rows())
+    assert mod.lint_chaos(str(path)) == []
+
+
+def test_chaos_lint_catches_every_schema_break(tmp_path):
+    mod = _load()
+    path = tmp_path / "trail.jsonl"
+    breaks = [
+        ({"ev": "chaos.inject", "kind": "count", "n": 1,
+          "seam": "s", "action": "explode"}, "action"),
+        ({"ev": "chaos.inject", "kind": "count", "n": 1,
+          "seam": "", "action": "kill"}, "seam"),
+        ({"ev": "wal.commit", "kernel": "k", "version": 0,
+          "reason": "promote", "ckpt": "k.v0.ckpt",
+          "sig": [1, 2]}, "version"),
+        ({"ev": "wal.commit", "kernel": "k", "version": 1,
+          "reason": "promote", "ckpt": "k.v1.ckpt",
+          "sig": [1.5, "x"]}, "sig"),
+        ({"ev": "wal.commit", "kernel": "k", "version": 1,
+          "reason": "promote", "ckpt": "not-a-checkpoint",
+          "sig": [1, 2]}, "ckpt"),
+        ({"ev": "wal.skip", "kind": "count", "n": 1,
+          "reason": "gremlins"}, "reason"),
+        ({"ev": "online.restore", "kernel": "k", "wal_version": "3",
+          "ckpt": "k.v3.ckpt"}, "wal_version"),
+        ({"ev": "serve.drain", "signal": "SIGTERM"}, "signal"),
+        ({"ev": "serve.unready", "reason": ""}, "reason"),
+        ({"ev": "drill.kill9", "ok": True, "restored_bitwise": False,
+          "recovery_s": 1.0, "lost": 0, "requests": 5},
+         "restored_bitwise"),
+        ({"ev": "drill.kill9", "ok": True, "restored_bitwise": True,
+          "recovery_s": None, "lost": 0, "requests": 5},
+         "recovery_s"),
+        ({"ev": "drill.sentinel", "ok": "yes"}, "ok"),
+        ({"ev": "drill.sentinel", "ok": True, "lost": -1}, "lost"),
+        ({"ev": "drill.mystery", "ok": True}, "unknown drill"),
+    ]
+    for rec, needle in breaks:
+        _write_sink(path, [rec])
+        failures = mod.lint_chaos(str(path))
+        assert failures, f"schema break not caught: {rec}"
+        assert any(needle in f for f in failures), (needle, failures)
+
+
+def test_chaos_lint_fails_an_empty_trail(tmp_path):
+    mod = _load()
+    path = tmp_path / "not_a_trail.jsonl"
+    _write_sink(path, [{"ev": "obs.summary", "kind": "summary"}])
+    assert any("no chaos" in f for f in mod.lint_chaos(str(path)))
+
+
+def test_main_chaos_flag_exit_codes(tmp_path, capsys):
+    mod = _load()
+    path = tmp_path / "trail.jsonl"
+    _write_sink(path, _chaos_rows())
+    assert mod.main(["--chaos", str(path)]) == 0
+    _write_sink(path, [{"ev": "wal.skip", "kind": "count", "n": 1,
+                        "reason": "gremlins"}])
+    assert mod.main(["--chaos", str(path)]) == 1
+    capsys.readouterr()

@@ -1,0 +1,295 @@
+"""Readiness, graceful drain, the loadgen ``lost`` class, the drill
+gate metrics, and the kill-9 acceptance drill (docs/resilience.md).
+
+The liveness/readiness split: ``/healthz`` answers whenever the
+process does, ``/readyz`` and the POST routes answer 503 +
+``Retry-After`` while the session is warming / replaying the WAL /
+draining.  SIGTERM's drain handler and the obs signal handler must
+flush the postmortem exactly once between them.  ``tools/loadgen.py``
+classifies connection-level failures as ``lost`` — distinct from
+``shed`` (429/503 after retries) and ``timeout`` (504) — which is what
+the drills measure.  The kill9 drill is the acceptance E2E: SIGKILL a
+live child mid-traffic, restart it on the same WAL dir, and prove the
+resident weights came back bitwise while goodput recovered.
+"""
+
+import http.client
+import importlib.util
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import registry as obs_registry
+from hpnn_tpu.serve.server import install_drain, make_server
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _kernel(seed=7):
+    k, _ = kernel_mod.generate(seed, 8, [5], 2)
+    return k
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = json.loads(resp.read().decode())
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, body, headers
+
+
+def _post(port, path, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("POST", path, json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read().decode())
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, body, headers
+
+
+def test_readiness_gates_the_post_routes(tmp_path):
+    sink = str(tmp_path / "sink.jsonl")
+    obs_registry._reset_for_tests()
+    obs.configure(sink)
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    server = make_server(sess, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        # born ready (the embed-and-go default)
+        code, body, _ = _get(port, "/readyz")
+        assert code == 200 and body == {"ready": True, "reason": None}
+
+        sess.mark_unready("warming")
+        code, body, headers = _get(port, "/readyz")
+        assert code == 503
+        assert headers.get("Retry-After") == "1"
+        assert body["ready"] is False and body["reason"] == "warming"
+        assert body["retriable"] is True
+        # liveness is unaffected...
+        code, body, _ = _get(port, "/healthz")
+        assert code == 200
+        # ...but work is refused, retriably, on every POST route
+        code, body, headers = _post(port, "/v1/infer",
+                                    {"kernel": "k",
+                                     "inputs": [0.0] * 8})
+        assert code == 503 and body["retriable"] is True
+        assert headers.get("Retry-After") == "1"
+        code, body, _ = _post(port, "/ingest",
+                              {"inputs": [0.0] * 8,
+                               "targets": [0.0, 0.0]})
+        assert code == 503
+
+        sess.mark_ready()
+        code, body, _ = _get(port, "/readyz")
+        assert code == 200
+        code, body, _ = _post(port, "/v1/infer",
+                              {"kernel": "k", "inputs": [0.0] * 8})
+        assert code == 200 and len(body["outputs"]) == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+        obs.configure(None)
+    with open(sink) as fp:
+        evs = [json.loads(ln) for ln in fp if ln.strip()]
+    unready = [e for e in evs if e.get("ev") == "serve.unready"]
+    assert [e["reason"] for e in unready] == ["warming"]
+    assert any(e.get("ev") == "serve.ready" for e in evs)
+
+
+def test_drain_flushes_postmortem_exactly_once(tmp_path):
+    sink = str(tmp_path / "sink.jsonl")
+    flight = str(tmp_path / "flight.jsonl")
+    obs_registry._reset_for_tests()
+    os.environ["HPNN_FLIGHT"] = flight
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    obs.configure(sink)
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("k", _kernel())
+    server = make_server(sess, port=0)
+    serve_thread = threading.Thread(target=server.serve_forever,
+                                    daemon=True)
+    serve_thread.start()
+    try:
+        obs.event("resilience.marker")  # give the flight ring a record
+        handler = install_drain(server, sess)
+        assert signal.getsignal(signal.SIGTERM) is handler
+        handler(signal.SIGTERM, None)
+        # idempotent: a second delivery and the chained obs signal
+        # handler both find the postmortem already flushed
+        handler(signal.SIGTERM, None)
+        obs_registry._crash_flush("obs.signal", "SIGTERM", "signal")
+        serve_thread.join(timeout=10)
+        assert not serve_thread.is_alive()
+        assert sess.is_ready() is False
+        assert sess.ready_doc()["reason"] == "draining"
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+        obs.configure(None)
+        os.environ.pop("HPNN_FLIGHT", None)
+        signal.signal(signal.SIGTERM, prev_handler)
+        obs_registry._reset_for_tests()
+    with open(sink) as fp:
+        evs = [json.loads(ln) for ln in fp if ln.strip()]
+    assert len([e for e in evs
+                if e.get("ev") == "serve.drain"]) == 1
+    assert len([e for e in evs
+                if e.get("ev") == "obs.signal"]) == 1
+    assert len([e for e in evs
+                if e.get("ev") == "obs.summary"]) == 1
+    assert os.path.exists(flight)  # the ring dumped, once
+
+
+def test_loadgen_shields_sigpipe_left_by_cli_mains():
+    """The CLIs install SIGPIPE=SIG_DFL for shell-pipeline manners; a
+    host that ran one of their mains in-process would then die with
+    rc=141 the moment a drill's target is killed mid-write.  loadgen
+    and the drills re-arm Python's default (ignore) on entry, so a
+    torn write surfaces as BrokenPipeError -> a ``lost`` record."""
+    loadgen = _load_tool("loadgen")
+    prev = signal.getsignal(signal.SIGPIPE)
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+        loadgen.shield_sigpipe()
+        assert signal.getsignal(signal.SIGPIPE) is signal.SIG_IGN
+        # run_open_loop arms it itself — callers need no ritual
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        loadgen.run_open_loop(
+            f"http://127.0.0.1:{port}", rate_rps=20.0, duration_s=0.1,
+            n_workers=2, max_retries=0, timeout_s=0.5)
+        assert signal.getsignal(signal.SIGPIPE) is signal.SIG_IGN
+    finally:
+        signal.signal(signal.SIGPIPE, prev)
+
+
+def test_loadgen_classifies_connection_loss_as_lost():
+    loadgen = _load_tool("loadgen")
+    with socket.socket() as s:  # find a port nothing listens on
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    summary = loadgen.run_open_loop(
+        f"http://127.0.0.1:{port}", rate_rps=40.0, duration_s=0.25,
+        n_workers=4, max_retries=0, timeout_s=0.5)
+    assert summary["lost"] == summary["requests"] > 0
+    assert summary["ok"] == summary["shed"] == summary["error"] == 0
+    assert summary["lost_rate"] == 1.0
+
+
+def test_loadgen_retries_503_then_records_shed():
+    loadgen = _load_tool("loadgen")
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("default", _kernel())
+    sess.mark_unready("warming")
+    server = make_server(sess, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        summary = loadgen.run_open_loop(
+            f"http://127.0.0.1:{port}", rate_rps=30.0,
+            duration_s=0.25, n_workers=4, max_retries=1,
+            retry_cap_s=0.01, timeout_s=2.0)
+        # every arrival was answered (nothing lost), refused politely
+        # (503 retried, then recorded as shed), served nothing
+        assert summary["shed"] == summary["requests"] > 0
+        assert summary["lost"] == summary["ok"] == 0
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+
+
+def test_loadgen_stop_event_ends_the_run_early():
+    loadgen = _load_tool("loadgen")
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=1.0)
+    sess.register_kernel("default", _kernel())
+    server = make_server(sess, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    stop = threading.Event()
+    seen = []
+
+    def on_record(rec):
+        seen.append(rec)
+        if len(seen) >= 3:
+            stop.set()
+
+    try:
+        t0 = time.perf_counter()
+        summary = loadgen.run_open_loop(
+            f"http://127.0.0.1:{port}", rate_rps=50.0,
+            duration_s=30.0, n_workers=4, stop=stop,
+            on_record=on_record)
+        wall = time.perf_counter() - t0
+        assert wall < 10.0          # nowhere near the 30s schedule
+        assert summary["duration_s"] < 10.0
+        assert len(seen) >= 3
+        assert summary["requests"] == len(seen)
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+
+
+def test_bench_gate_covers_the_drill_metrics():
+    gate = _load_tool("bench_gate")
+    for metric in ("drill_recovery_s", "drill_goodput_dip_pct",
+                   "drill_lost_requests"):
+        direction, tol = gate.GATE_METRICS[metric]
+        assert direction == "lower" and tol >= 1.0
+    base = [{"drill_recovery_s": 1.0, "drill_lost_requests": 0}] * 3
+    # 4x the baseline recovery: past the 150% tolerance -> regression
+    bad = gate.gate(gate.flatten({"drill_recovery_s": 4.0}),
+                    gate.baseline(base, 5))
+    assert [r["metric"] for r in bad] == ["drill_recovery_s"]
+    ok = gate.gate(gate.flatten({"drill_recovery_s": 2.0}),
+                   gate.baseline(base, 5))
+    assert ok == []
+    # the zero-baseline rule: a 0-lost baseline cannot ratio-gate, so
+    # lost stays un-gated until some baseline run records a loss
+    skipped = gate.gate(gate.flatten({"drill_lost_requests": 25}),
+                        gate.baseline(base, 5))
+    assert skipped == []
+
+
+def test_drill_kill9_end_to_end(tmp_path):
+    """The acceptance drill: a real ``online_nn`` child under live
+    loadgen traffic is SIGKILLed after a WAL-committed promotion and
+    restarted on the same port + WAL dir.  The restarted resident
+    weights must equal the supervisor's own read of the last committed
+    checkpoint bitwise, and goodput must recover."""
+    chaos_drill = _load_tool("chaos_drill")
+    res = chaos_drill.drill_kill9(workdir=str(tmp_path), rate=30.0)
+    assert res["ok"], res
+    assert res["restored_bitwise"] is True
+    assert res["wal_version"] >= 1
+    assert res["recovery_s"] is not None and res["recovery_s"] >= 0.0
+    assert res["lost"] >= 0 and res["requests"] > res["lost"]
+    # the catalog lint accepts the row it just produced
+    lint = _load_tool("check_obs_catalog")
+    row_path = tmp_path / "drill.jsonl"
+    row_path.write_text(json.dumps(res) + "\n")
+    assert lint.lint_chaos(str(row_path)) == []

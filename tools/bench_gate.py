@@ -68,6 +68,16 @@ GATE_METRICS = {
     "online_goodput_rps": ("higher", 0.40),
     "online_goodput_vs_idle": ("higher", 0.25),
     "online_promote_latency_ms": ("lower", 1.00),
+    # chaos-drill fold-in (tools/chaos_drill.py run_bench_drill):
+    # kill -9 a live online_nn child mid-traffic, restart, measure
+    # the blast radius.  Recovery and dip are timing-noisy subprocess
+    # measurements, so the tolerances are generous; lost counts
+    # in-flight requests the kill destroyed (baseline 0 is skipped by
+    # the gate's zero-baseline rule, so this arms once a baseline
+    # run records any loss)
+    "drill_recovery_s": ("lower", 1.50),
+    "drill_goodput_dip_pct": ("lower", 1.00),
+    "drill_lost_requests": ("lower", 2.00),
 }
 
 

@@ -1,0 +1,595 @@
+#!/usr/bin/env python3
+"""Chaos drills: scripted disasters against a live train-while-serve
+process, with the blast radius measured, not guessed.
+
+Each drill is a supervisor: it spawns a real ``online_nn`` server as
+a child process, drives it with ``tools/loadgen.py`` open-loop
+traffic (the ``lost`` outcome class — connection refused/reset/torn
+response — is this tool's raw material), injures it on purpose, and
+reports how far the damage spread and how fast it healed.  The drill
+catalog (docs/resilience.md):
+
+* **kill9** — SIGKILL mid-traffic after at least one promotion has
+  committed to the WAL (``HPNN_WAL_DIR``), then restart on the same
+  port and WAL dir.  Asserts the restarted process resumes the last
+  *committed* weights bitwise (``/healthz`` ``weights_sha`` vs the
+  supervisor's own read of the WAL checkpoint), that the readiness
+  gate (``/readyz`` 503 + Retry-After) holds traffic while the WAL
+  replays, and measures goodput dip %, recovery seconds, and lost
+  requests from the loadgen record stream.
+* **reload** — hot-reload under load: the supervisor rewrites the
+  served checkpoint file and POSTs ``/v1/reload`` while traffic
+  flows, with ``HPNN_CHAOS="raise@registry.reload:times=1"`` armed in
+  the child so the FIRST attempt fails (500, retriable, resident
+  version kept) and the retry lands.  Asserts the new weights are
+  served, nothing was lost, and goodput held.
+* **sentinel** — ``HPNN_CHAOS="nan@train.round"`` corrupts every
+  trained candidate; the promotion gate's sentinel must reject all of
+  them while serving stays clean (version pinned, zero lost).
+
+Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
+``drill.reload`` | ``drill.sentinel``; :func:`run_bench_drill` is the
+bench.py fold-in (compact keys ``drill_recovery_s`` /
+``drill_goodput_dip_pct`` / ``drill_lost_requests``, gated by
+``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
+child cannot start.
+
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --drill kill9
+    python tools/chaos_drill.py --drill all --out drills.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+KERNEL = "drill"
+
+CONF = (f"[name] {KERNEL}\n[type] ANN\n[init] generate\n[seed] 7\n"
+        "[input] 8\n[hidden] 5\n[output] 2\n[train] BP\n")
+
+
+# ------------------------------------------------------------ plumbing
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_get(port: int, path: str, timeout_s: float = 2.0):
+    """-> (status, parsed-json-or-None); (None, None) when nothing
+    answered (refused/reset — the connection-level loss class)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            return resp.status, json.loads(data)
+        except ValueError:
+            return resp.status, None
+    except (OSError, http.client.HTTPException):
+        return None, None
+    finally:
+        conn.close()
+
+
+def http_post(port: int, path: str, body: dict,
+              timeout_s: float = 5.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("POST", path, body=json.dumps(body).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            return resp.status, json.loads(data)
+        except ValueError:
+            return resp.status, None
+    except (OSError, http.client.HTTPException):
+        return None, None
+    finally:
+        conn.close()
+
+
+def weights_sha(weights) -> str:
+    """Bitwise identity of a weight tuple — the same digest the
+    online session publishes per kernel in ``/healthz``."""
+    sha = hashlib.sha256()
+    for w in weights:
+        sha.update(np.ascontiguousarray(np.asarray(w)).tobytes())
+    return sha.hexdigest()[:16]
+
+
+class Child:
+    """One ``online_nn`` child process under supervision."""
+
+    def __init__(self, workdir: str, port: int, *, wal_dir=None,
+                 chaos=None, interval_s: float = 0.2,
+                 rows: int = 16, batch: int = 8, epochs: int = 2,
+                 margin: float = -0.5, log_name: str = "child.log"):
+        self.workdir = workdir
+        self.port = port
+        conf_path = os.path.join(workdir, "nn.conf")
+        if not os.path.exists(conf_path):
+            with open(conf_path, "w") as fp:
+                fp.write(CONF)
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("HPNN_CHAOS", None)
+        env.pop("HPNN_CHAOS_SEED", None)
+        env.pop("HPNN_WAL_DIR", None)
+        if wal_dir:
+            env["HPNN_WAL_DIR"] = str(wal_dir)
+        if chaos:
+            env["HPNN_CHAOS"] = chaos
+        self.log_path = os.path.join(workdir, log_name)
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "hpnn_tpu.cli.online_nn",
+             "--port", str(port),
+             "--interval-s", str(interval_s),
+             "--rows", str(rows), "--batch", str(batch),
+             "--epochs", str(epochs), "--margin", str(margin),
+             conf_path],
+            cwd=ROOT, env=env, stdin=subprocess.DEVNULL,
+            stdout=self._log, stderr=self._log)
+
+    def wait_ready(self, timeout_s: float = 90.0) -> dict:
+        """Poll ``/readyz`` until 200; returns
+        ``{"ready": bool, "gated": saw-a-503, "waited_s": ...}``."""
+        t0 = time.monotonic()
+        gated = False
+        while time.monotonic() - t0 < timeout_s:
+            if self.proc.poll() is not None:
+                break
+            code, _doc = http_get(self.port, "/readyz",
+                                  timeout_s=1.0)
+            if code == 200:
+                return {"ready": True, "gated": gated,
+                        "waited_s": round(time.monotonic() - t0, 3)}
+            if code == 503:
+                gated = True
+            time.sleep(0.05)
+        return {"ready": False, "gated": gated,
+                "waited_s": round(time.monotonic() - t0, 3)}
+
+    def health(self) -> dict | None:
+        code, doc = http_get(self.port, "/healthz")
+        return doc if code == 200 else None
+
+    def kill9(self) -> None:
+        try:
+            self.proc.send_signal(signal.SIGKILL)
+        except OSError:
+            pass
+        self.proc.wait(timeout=10)
+        self._close_log()
+
+    def terminate(self, timeout_s: float = 10.0) -> int | None:
+        """SIGTERM (the graceful-drain path) and wait; returns the
+        exit code (0 proves the drain handler ran to completion)."""
+        if self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                self.proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        self._close_log()
+        return self.proc.returncode
+
+    def _close_log(self):
+        try:
+            self._log.close()
+        except OSError:
+            pass
+
+
+# -------------------------------------------------------- measurement
+
+
+def goodput_bins(records: list[dict], *, bin_s: float = 0.5) -> dict:
+    """Per-bin ok counts keyed by bin start offset (seconds on the
+    loadgen clock)."""
+    bins: dict[float, int] = {}
+    for r in records:
+        b = round(int(r["t"] / bin_s) * bin_s, 3)
+        bins.setdefault(b, 0)
+        if r["status"] == "ok":
+            bins[b] += 1
+    return bins
+
+
+def blast_radius(records: list[dict], t_kill: float, *,
+                 bin_s: float = 0.5,
+                 recovered_frac: float = 0.8) -> dict:
+    """Goodput dip/recovery around a disruption at ``t_kill`` (same
+    clock as the records' ``t``): baseline = median ok-count of the
+    pre-kill bins, recovery = first post-kill bin back at
+    ``recovered_frac`` of baseline."""
+    bins = goodput_bins(records, bin_s=bin_s)
+    pre = [n for b, n in sorted(bins.items()) if b + bin_s <= t_kill]
+    base = float(np.median(pre)) if pre else 0.0
+    post = [(b, n) for b, n in sorted(bins.items()) if b >= t_kill]
+    recovery_s = None
+    dip = base
+    for b, n in post:
+        dip = min(dip, n)
+        if base > 0 and n >= recovered_frac * base:
+            recovery_s = round(b + bin_s - t_kill, 3)
+            break
+    dip_pct = (round(100.0 * (base - dip) / base, 1) if base > 0
+               else None)
+    lost = sum(1 for r in records if r["status"] == "lost")
+    shed = sum(1 for r in records if r["status"] == "shed")
+    return {
+        "baseline_ok_per_bin": base,
+        "bin_s": bin_s,
+        "goodput_dip_pct": dip_pct,
+        "recovery_s": recovery_s,
+        "lost": lost,
+        "shed": shed,
+        "requests": len(records),
+    }
+
+
+class _Load:
+    """Background loadgen run with live record capture + early stop."""
+
+    def __init__(self, port: int, *, rate: float = 40.0,
+                 duration_s: float = 240.0, ingest_frac: float = 0.5,
+                 retries: int = 3, seed: int = 0):
+        import loadgen
+
+        self.records: list[dict] = []
+        self.stop = threading.Event()
+        self.summary: dict | None = None
+        self.t0 = time.perf_counter()
+
+        def run():
+            self.summary = loadgen.run_open_loop(
+                f"http://127.0.0.1:{port}", rate_rps=rate,
+                duration_s=duration_s, process="poisson",
+                kernels=(KERNEL,), rows_choices=(1, 2),
+                n_in=8, timeout_s=2.0, max_retries=retries,
+                retry_cap_s=0.25, n_workers=8, seed=seed,
+                ingest_frac=ingest_frac, n_out=2, stop=self.stop,
+                on_record=self.records.append)
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def now(self) -> float:
+        """Offset on the records' ``t`` clock (same perf_counter
+        epoch, modulo loadgen's own setup time — well under a bin)."""
+        return time.perf_counter() - self.t0
+
+    def finish(self, settle_s: float = 0.0) -> list[dict]:
+        if settle_s > 0:
+            time.sleep(settle_s)
+        self.stop.set()
+        self.thread.join(timeout=30)
+        return list(self.records)
+
+
+def _wait(pred, timeout_s: float, interval_s: float = 0.1):
+    """Poll ``pred()`` until truthy; returns its value or None."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval_s)
+    return None
+
+
+# ------------------------------------------------------------- drills
+
+
+def _shield_sigpipe() -> None:
+    """SIGPIPE back to ignored (Python's startup default) before any
+    drill traffic: the supervisor deliberately kills children that
+    hold live sockets, and a host process that ran one of the CLI
+    mains in-process would otherwise carry their SIG_DFL disposition
+    — turning the drill's own measurement (a torn write, recorded as
+    ``lost``) into supervisor death.  Must run on the main thread;
+    loadgen's worker threads inherit the process disposition."""
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+    except (ValueError, AttributeError):  # non-main thread / platform
+        pass
+
+
+def drill_kill9(workdir: str, *, rate: float = 40.0,
+                promote_timeout_s: float = 60.0,
+                ready_timeout_s: float = 90.0,
+                seed: int = 0) -> dict:
+    """SIGKILL mid-traffic after a WAL-committed promotion, restart
+    on the same port + WAL dir, prove bitwise resume + measure the
+    blast radius."""
+    from hpnn_tpu.online import wal as wal_mod
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.kill9", "ok": False}
+    wal_dir = os.path.join(workdir, "wal")
+    port = free_port()
+    child = Child(workdir, port, wal_dir=wal_dir,
+                  log_name="kill9-a.log")
+    try:
+        ready = child.wait_ready(ready_timeout_s)
+        if not ready["ready"]:
+            out["skipped"] = "child never became ready"
+            return out
+        load = _Load(port, rate=rate, ingest_frac=0.5, seed=seed)
+
+        def promoted():
+            doc = child.health()
+            if doc is None:
+                return None
+            on = doc.get("online", {})
+            if on.get("promoter", {}).get("promoted", 0) < 1:
+                return None
+            w = wal_mod.PromotionWAL(wal_dir)
+            return w.last_committed(KERNEL)
+
+        rec = _wait(promoted, promote_timeout_s, interval_s=0.2)
+        if rec is None:
+            load.finish()
+            out["skipped"] = "no WAL-committed promotion in time"
+            return out
+        # let post-promotion goodput establish the baseline bins
+        time.sleep(1.5)
+        t_kill = load.now()
+        child.kill9()
+        # ground truth from the supervisor's own read of the WAL
+        restored = wal_mod.PromotionWAL(wal_dir).restore(KERNEL)
+        if restored is None:
+            load.finish()
+            out["error"] = "WAL unreadable after kill"
+            return out
+        ws, rec = restored
+        expect_sha = weights_sha(ws)
+        child = Child(workdir, port, wal_dir=wal_dir,
+                      log_name="kill9-b.log")
+        ready = child.wait_ready(ready_timeout_s)
+        out["readyz_gated"] = ready["gated"]
+        out["restart_ready_s"] = ready["waited_s"]
+        if not ready["ready"]:
+            load.finish()
+            out["error"] = "restarted child never became ready"
+            return out
+        # read the resident digest at the readiness edge, BEFORE the
+        # settle traffic: the restarted trainer starts promoting new
+        # versions within a round or two, and those are supposed to
+        # differ from the restored checkpoint
+        doc = child.health() or {}
+        kdoc = doc.get("online", {}).get("kernels", {}).get(KERNEL, {})
+        got_sha = kdoc.get("weights_sha")
+        records = load.finish(settle_s=2.0)
+        out.update(blast_radius(records, t_kill))
+        out["wal_version"] = int(rec.get("version", -1))
+        out["weights_sha"] = {"expect": expect_sha, "got": got_sha}
+        out["restored_bitwise"] = bool(got_sha == expect_sha)
+        out["restored_doc"] = (doc.get("online", {}).get("wal", {})
+                               .get("restored"))
+        out["ok"] = bool(out["restored_bitwise"]
+                         and out["recovery_s"] is not None)
+        return out
+    finally:
+        child.terminate()
+
+
+def drill_reload(workdir: str, *, rate: float = 40.0,
+                 ready_timeout_s: float = 90.0,
+                 seed: int = 1) -> dict:
+    """Hot-reload under load, first attempt chaos-failed: rewrite the
+    served checkpoint, POST /v1/reload twice (raise@registry.reload
+    armed for one firing), prove the new weights landed with zero
+    lost requests."""
+    from hpnn_tpu.fileio import checkpoint as ckpt_mod
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.online import wal as wal_mod
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.reload", "ok": False}
+    wal_dir = os.path.join(workdir, "wal")
+    # seed the WAL so the child's kernel is checkpoint-backed (the
+    # hot-reload path needs a file to watch)
+    k1, _ = kernel_mod.generate(11, 8, [5], 2)
+    wal = wal_mod.PromotionWAL(wal_dir)
+    rec = wal.commit(KERNEL, k1.weights, version=1, reason="seed")
+    ckpt_path = os.path.join(wal_dir, rec["ckpt"])
+    port = free_port()
+    child = Child(workdir, port, wal_dir=wal_dir,
+                  chaos="raise@registry.reload:times=1",
+                  interval_s=60.0,  # trainer parked: reload is the act
+                  log_name="reload.log")
+    try:
+        ready = child.wait_ready(ready_timeout_s)
+        if not ready["ready"]:
+            out["skipped"] = "child never became ready"
+            return out
+        load = _Load(port, rate=rate, ingest_frac=0.0, seed=seed)
+        time.sleep(1.5)           # baseline bins
+        k2, _ = kernel_mod.generate(13, 8, [5], 2)
+        ckpt_mod.dump_checkpoint(ckpt_path, KERNEL, k2.weights,
+                                 version=2, meta={"reason": "drill"})
+        t_act = load.now()
+        code1, _ = http_post(port, "/v1/reload", {"kernel": KERNEL})
+        code2, _ = http_post(port, "/v1/reload", {"kernel": KERNEL})
+        records = load.finish(settle_s=1.5)
+        doc = child.health() or {}
+        kdoc = doc.get("online", {}).get("kernels", {}).get(KERNEL, {})
+        out.update(blast_radius(records, t_act))
+        out["reload_codes"] = [code1, code2]
+        out["chaos_failed_first"] = bool(code1 == 500)
+        out["weights_sha"] = {"expect": weights_sha(k2.weights),
+                              "got": kdoc.get("weights_sha")}
+        out["reloaded_bitwise"] = (out["weights_sha"]["got"]
+                                   == out["weights_sha"]["expect"])
+        out["ok"] = bool(out["chaos_failed_first"]
+                         and code2 == 200
+                         and out["reloaded_bitwise"]
+                         and out["lost"] == 0)
+        return out
+    finally:
+        child.terminate()
+
+
+def drill_sentinel(workdir: str, *, rate: float = 40.0,
+                   ready_timeout_s: float = 90.0,
+                   reject_timeout_s: float = 60.0,
+                   seed: int = 2) -> dict:
+    """Sentinel abort under load: every candidate is NaN-corrupted
+    (``nan@train.round``); the gate must reject them all while the
+    resident version keeps serving untouched."""
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.sentinel", "ok": False}
+    port = free_port()
+    child = Child(workdir, port, chaos="nan@train.round",
+                  log_name="sentinel.log")
+    try:
+        ready = child.wait_ready(ready_timeout_s)
+        if not ready["ready"]:
+            out["skipped"] = "child never became ready"
+            return out
+        doc0 = child.health() or {}
+        k0 = doc0.get("online", {}).get("kernels", {}).get(KERNEL, {})
+        sha0, v0 = k0.get("weights_sha"), k0.get("version")
+        load = _Load(port, rate=rate, ingest_frac=0.5, seed=seed)
+
+        def rejected():
+            doc = child.health()
+            if doc is None:
+                return None
+            on = doc.get("online", {})
+            return (on.get("promoter", {}).get("rejected", 0) >= 2
+                    and on.get("trainer", {}).get("trained", 0) >= 2
+                    or None)
+
+        saw = _wait(rejected, reject_timeout_s, interval_s=0.2)
+        records = load.finish(settle_s=0.5)
+        doc = child.health() or {}
+        on = doc.get("online", {})
+        k1 = on.get("kernels", {}).get(KERNEL, {})
+        out["rejected"] = on.get("promoter", {}).get("rejected", 0)
+        out["promoted"] = on.get("promoter", {}).get("promoted", 0)
+        out["version"] = {"before": v0, "after": k1.get("version")}
+        out["weights_sha"] = {"before": sha0,
+                              "after": k1.get("weights_sha")}
+        out["lost"] = sum(1 for r in records
+                          if r["status"] == "lost")
+        out["requests"] = len(records)
+        out["ok"] = bool(saw
+                         and out["promoted"] == 0
+                         and k1.get("version") == v0
+                         and k1.get("weights_sha") == sha0
+                         and out["lost"] == 0)
+        return out
+    finally:
+        child.terminate()
+
+
+DRILLS = {
+    "kill9": drill_kill9,
+    "reload": drill_reload,
+    "sentinel": drill_sentinel,
+}
+
+
+def run_drills(names, *, workdir: str | None = None,
+               rate: float = 40.0) -> list[dict]:
+    rows = []
+    for name in names:
+        with tempfile.TemporaryDirectory() as tmp:
+            wd = workdir or tmp
+            os.makedirs(wd, exist_ok=True)
+            rows.append(DRILLS[name](wd, rate=rate))
+    return rows
+
+
+# -------------------------------------------------------------- bench
+
+
+def run_bench_drill(*, rate: float = 40.0) -> dict:
+    """The bench.py fold-in: the kill9 drill's blast radius as three
+    gateable numbers.  ``skipped`` (never an exception) when the
+    child cannot come up in this environment."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_kill9(tmp, rate=rate)
+    out = {
+        "metric": "chaos_drill",
+        "drill": row,
+        "recovery_s": row.get("recovery_s"),
+        "goodput_dip_pct": row.get("goodput_dip_pct"),
+        "lost_requests": row.get("lost"),
+        "restored_bitwise": row.get("restored_bitwise"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
+# --------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="chaos drills against a live online_nn child "
+                    "(kill9 / reload / sentinel)")
+    ap.add_argument("--drill", default="all",
+                    choices=("all", "kill9", "reload", "sentinel"))
+    ap.add_argument("--rate", type=float, default=40.0,
+                    help="loadgen offered load during the drill")
+    ap.add_argument("--workdir",
+                    help="keep child conf/logs/WAL here (default: "
+                         "a temp dir per drill)")
+    ap.add_argument("--out", help="append drill JSONL rows here")
+    args = ap.parse_args(argv)
+    names = (list(DRILLS) if args.drill == "all" else [args.drill])
+    rows = run_drills(names, workdir=args.workdir, rate=args.rate)
+    if args.out:
+        with open(args.out, "a") as fp:
+            for row in rows:
+                fp.write(json.dumps(row) + "\n")
+    for row in rows:
+        sys.stderr.write(f"{row['ev']}: "
+                         f"{'ok' if row.get('ok') else row}\n")
+    print(json.dumps({"drills": rows,
+                      "ok": all(r.get("ok") for r in rows)}))
+    return 0 if all(r.get("ok") for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

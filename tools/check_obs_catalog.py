@@ -40,6 +40,16 @@ the request-id span attributes feed the load harness
 (tools/loadgen.py) and /healthz verdicts, so their shapes are checked
 too.
 
+And the chaos/durability schema lint (:func:`lint_chaos`): the
+``chaos.inject`` counts (HPNN_CHAOS, hpnn_tpu/chaos/), the promotion
+WAL records (``wal.commit`` / ``wal.skip``, HPNN_WAL_DIR,
+hpnn_tpu/online/wal.py), the checkpoint/restore/drain events, and the
+``drill.*`` rows ``tools/chaos_drill.py`` writes are the audit trail
+for *deliberate* failures — a drill row that can't say what it
+injected, what was lost, or whether the restart resumed bitwise makes
+the whole exercise theater, so their shapes are frozen like the
+ledger rows (docs/resilience.md).
+
 And the online-learning schema lint (:func:`lint_online`): the
 ``online.*`` records (hpnn_tpu/online/, docs/online.md) are the audit
 trail for *weight promotions in a live serving process* — a promote
@@ -51,7 +61,7 @@ bookkeeping) are frozen the same way the ledger rows are.
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
-        [--slo PATH] [--online PATH]
+        [--slo PATH] [--online PATH] [--chaos PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -78,7 +88,7 @@ DOC_RE = re.compile(
 )
 
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
-             "docs/fleet.md", "docs/online.md")
+             "docs/fleet.md", "docs/online.md", "docs/resilience.md")
 SRC_DIR = "hpnn_tpu"
 
 
@@ -665,6 +675,187 @@ def lint_online(path: str) -> list[str]:
     return failures
 
 
+# the chaos/durability record contracts (hpnn_tpu/chaos/,
+# hpnn_tpu/online/wal.py, tools/chaos_drill.py; docs/resilience.md)
+CHAOS_ACTIONS = ("kill", "raise", "delay", "nan")
+WAL_SKIP_REASONS = ("sig", "torn", "magic")
+DRILL_EVS = ("drill.kill9", "drill.reload", "drill.sentinel")
+
+
+def lint_chaos(path: str) -> list[str]:
+    """Schema-lint the chaos/durability records of one JSONL file —
+    a metrics sink from a chaos-armed run, a promotion WAL, a drill
+    output, or any interleaving of the three.
+
+    Checks, per record:
+
+    * ``chaos.inject`` counts — ``kind == "count"``, positive ``n``,
+      non-empty ``seam``, ``action`` one of kill/raise/delay/nan (an
+      injection that can't say what it did where is unauditable).
+    * ``wal.commit`` — non-empty ``kernel``; ``version`` an int >= 1;
+      ``ckpt`` a non-empty ``.ckpt`` basename; ``sig`` a 2-list of
+      ints (the registry staleness signature); non-empty ``reason``.
+    * ``wal.skip`` counts — ``reason`` one of sig/torn/magic.
+    * ``online.checkpoint`` / ``online.restore`` — non-empty
+      ``kernel``, version int >= 1, non-empty ``ckpt``;
+      ``online.checkpoint_failed`` counts a non-empty ``reason``.
+    * ``serve.drain`` — an int ``signal``; ``serve.unready`` — a
+      non-empty ``reason``.
+    * ``drill.*`` rows — a bool ``ok``; a passing kill9 row must
+      carry ``restored_bitwise`` true, a non-negative ``recovery_s``,
+      and non-negative int ``lost``/``requests`` tallies.
+
+    A file with none of these record families fails.  Returns failure
+    strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read {path!r}: {exc}"]
+    n_seen = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev == "chaos.inject":
+            n_seen += 1
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: chaos.inject kind {rec.get('kind')!r} "
+                    "!= 'count'")
+            if not _pos_int(rec.get("n")):
+                failures.append(
+                    f"{at}: chaos.inject increment {rec.get('n')!r} "
+                    "is not a positive int")
+            seam = rec.get("seam")
+            if not isinstance(seam, str) or not seam:
+                failures.append(
+                    f"{at}: chaos.inject seam {seam!r} is not a "
+                    "non-empty string")
+            if rec.get("action") not in CHAOS_ACTIONS:
+                failures.append(
+                    f"{at}: chaos.inject action {rec.get('action')!r} "
+                    f"not in {'/'.join(CHAOS_ACTIONS)}")
+        elif ev == "wal.commit":
+            n_seen += 1
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: wal.commit kernel {k!r} is not a "
+                    "non-empty string")
+            if not _pos_int(rec.get("version")):
+                failures.append(
+                    f"{at}: wal.commit version "
+                    f"{rec.get('version')!r} is not an int >= 1")
+            ckpt = rec.get("ckpt")
+            if not isinstance(ckpt, str) or not ckpt.endswith(".ckpt"):
+                failures.append(
+                    f"{at}: wal.commit ckpt {ckpt!r} is not a .ckpt "
+                    "basename")
+            sig = rec.get("sig")
+            if (not isinstance(sig, list) or len(sig) != 2
+                    or not all(isinstance(v, int)
+                               and not isinstance(v, bool)
+                               for v in sig)):
+                failures.append(
+                    f"{at}: wal.commit sig {sig!r} is not a 2-list "
+                    "of ints")
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r:
+                failures.append(
+                    f"{at}: wal.commit reason {r!r} is not a "
+                    "non-empty string")
+        elif ev == "wal.skip":
+            n_seen += 1
+            if rec.get("reason") not in WAL_SKIP_REASONS:
+                failures.append(
+                    f"{at}: wal.skip reason {rec.get('reason')!r} not "
+                    f"in {'/'.join(WAL_SKIP_REASONS)}")
+        elif ev in ("online.checkpoint", "online.restore"):
+            n_seen += 1
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: {ev} kernel {k!r} is not a non-empty "
+                    "string")
+            vkey = ("wal_version" if ev == "online.restore"
+                    else "version")
+            if not _pos_int(rec.get(vkey)):
+                failures.append(
+                    f"{at}: {ev} {vkey} {rec.get(vkey)!r} is not an "
+                    "int >= 1")
+            ckpt = rec.get("ckpt")
+            if not isinstance(ckpt, str) or not ckpt:
+                failures.append(
+                    f"{at}: {ev} ckpt {ckpt!r} is not a non-empty "
+                    "string")
+        elif ev == "online.checkpoint_failed":
+            n_seen += 1
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r:
+                failures.append(
+                    f"{at}: online.checkpoint_failed reason {r!r} is "
+                    "not a non-empty string")
+        elif ev == "serve.drain":
+            n_seen += 1
+            sig = rec.get("signal")
+            if not isinstance(sig, int) or isinstance(sig, bool):
+                failures.append(
+                    f"{at}: serve.drain signal {sig!r} is not an int")
+        elif ev == "serve.unready":
+            n_seen += 1
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r:
+                failures.append(
+                    f"{at}: serve.unready reason {r!r} is not a "
+                    "non-empty string")
+        elif isinstance(ev, str) and ev.startswith("drill."):
+            n_seen += 1
+            if ev not in DRILL_EVS:
+                failures.append(
+                    f"{at}: unknown drill row {ev!r} (want one of "
+                    f"{'/'.join(DRILL_EVS)})")
+                continue
+            ok = rec.get("ok")
+            if not isinstance(ok, bool):
+                failures.append(
+                    f"{at}: {ev} ok {ok!r} is not a bool")
+            for key in ("lost", "requests"):
+                v = rec.get(key)
+                if v is not None and (not isinstance(v, int)
+                                      or isinstance(v, bool)
+                                      or v < 0):
+                    failures.append(
+                        f"{at}: {ev} {key} {v!r} is not a "
+                        "non-negative int")
+            if ev == "drill.kill9" and ok:
+                if rec.get("restored_bitwise") is not True:
+                    failures.append(
+                        f"{at}: passing drill.kill9 without "
+                        "restored_bitwise=true — the restart was "
+                        "never proven bitwise")
+                rs = rec.get("recovery_s")
+                if not _num(rs) or not math.isfinite(rs) or rs < 0:
+                    failures.append(
+                        f"{at}: passing drill.kill9 recovery_s "
+                        f"{rs!r} is not a non-negative number")
+    if not n_seen:
+        failures.append(
+            f"{path!r} has no chaos.* / wal.* / drill.* / "
+            "drain records — was HPNN_CHAOS or HPNN_WAL_DIR set, or "
+            "is this not a drill output?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -694,6 +885,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_online(argv[i + 1])
+    if "--chaos" in argv:
+        i = argv.index("--chaos")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --chaos needs a "
+                             "path\n")
+            return 2
+        failures += lint_chaos(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -25,8 +25,11 @@ attribution (``HPNN_SPANS`` / ``HPNN_COST``), the SLO tracker
 actual Shed rejection in the serve section below), the whole
 ``HPNN_ONLINE_*`` train-while-serve knob family (inert outside
 ``hpnn_tpu/online/``; a full feed → train → gate → rollback round is
-additionally exercised to silence below), and a live export server
-whose
+additionally exercised to silence below), the chaos + durability
+knobs (``HPNN_CHAOS`` / ``HPNN_CHAOS_SEED`` / ``HPNN_WAL_DIR``,
+docs/resilience.md — the train path carries no injection seams and
+never touches the WAL, so an armed plan must stay inert here), and a
+live export server whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
 minimal one.  A final ledger-only run proves the probes are
@@ -161,6 +164,15 @@ def check(tmpdir: str) -> list[str]:
                      ("HPNN_ONLINE_INTERVAL_S", "60"),
                      ("HPNN_ONLINE_MARGIN", "0.0"),
                      ("HPNN_ONLINE_WATCH_S", "5"))
+    # chaos + durability (docs/resilience.md) ride the same proof: an
+    # ARMED plan whose seams never trigger on the train path (the
+    # delay fault targets a real serve seam; the train round never
+    # dispatches through it) plus a live WAL dir the round never
+    # commits to — not a byte, not a file
+    from hpnn_tpu import chaos as chaos_mod
+    from hpnn_tpu.online import wal as wal_mod
+
+    wal_dir = os.path.join(tmpdir, "wal")
     ledger_b = os.path.join(tmpdir, "ledger_b.jsonl")
     os.environ["HPNN_FLIGHT"] = os.path.join(tmpdir, "flight.jsonl")
     os.environ["HPNN_PROBES"] = "1"
@@ -169,24 +181,36 @@ def check(tmpdir: str) -> list[str]:
     os.environ["HPNN_SPANS"] = "1"
     os.environ["HPNN_COST"] = "1"
     os.environ["HPNN_SLO_MS"] = "50"
+    os.environ["HPNN_CHAOS"] = "delay@serve.dispatch:ms=0"
+    os.environ["HPNN_CHAOS_SEED"] = "1"
+    os.environ["HPNN_WAL_DIR"] = wal_dir
     for knob, val in _ONLINE_KNOBS:
         os.environ[knob] = val
+    chaos_mod._reset_for_tests()
+    wal_mod._reset_for_tests()
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
     finally:
         for knob in ("HPNN_FLIGHT", "HPNN_PROBES", "HPNN_NUMERICS",
                      "HPNN_LEDGER", "HPNN_SPANS", "HPNN_COST",
-                     "HPNN_SLO_MS") + tuple(k for k, _ in _ONLINE_KNOBS):
+                     "HPNN_SLO_MS", "HPNN_CHAOS", "HPNN_CHAOS_SEED",
+                     "HPNN_WAL_DIR") + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
+        chaos_mod._reset_for_tests()
+        wal_mod._reset_for_tests()
 
     if plain != instrumented:
         failures.append(
             "stdout is NOT byte-identical with HPNN_METRICS + "
             "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
-            "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + HPNN_ONLINE_* + "
-            "export server all enabled "
+            "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + HPNN_CHAOS + "
+            "HPNN_WAL_DIR + HPNN_ONLINE_* + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
+    if os.path.exists(os.path.join(wal_dir, wal_mod.WAL_NAME)):
+        failures.append(
+            "a plain train round wrote the promotion WAL — "
+            "HPNN_WAL_DIR must be inert outside hpnn_tpu/online/")
     body = scraped.get("metrics", "")
     if "# TYPE" not in body or "hpnn_" not in body:
         failures.append(

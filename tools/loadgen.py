@@ -22,15 +22,18 @@ coalesce same-topology kernels transparently) and row counts
 fraction of requests ``POST /ingest`` sample feeds (``--n-out`` sets
 the target width), so ONE loadgen run drives the full
 train-while-serve loop against an ``online_nn`` server
-(docs/online.md).  429 responses are retried
+(docs/online.md).  429 and 503 responses are retried
 honoring ``Retry-After`` (capped; ``--retries 0`` records the shed
-instead), 504/timeouts are terminal per request.  The server's
+instead), 504/timeouts are terminal per request; connection-level
+failures (refused, reset, incomplete response) are a distinct
+``lost`` class — the blast-radius metric the chaos drills in
+``tools/chaos_drill.py`` gate on (docs/resilience.md).  The server's
 ``X-Request-Id`` is recorded per outcome, so any row in the JSONL
 (``--out``) cross-correlates with the span sink via
 ``tools/obs_report.py --spans --req <id>``.
 
 Outcome rows: ``{"t", "kernel", "rows", "status": ok|shed|timeout|
-error, "code", "latency_ms", "req_id", "attempts"}``; the summary
+error|lost, "code", "latency_ms", "req_id", "attempts"}``; the summary
 (ONE JSON line on stdout, the bench.py convention) reports
 p50/p99/p99.9 of *served* latencies, goodput vs offered load, and
 shed/timeout rates.  :func:`run_bench_load` is the self-contained
@@ -51,6 +54,7 @@ import http.client
 import json
 import os
 import queue
+import signal
 import socket
 import sys
 import threading
@@ -58,6 +62,21 @@ import time
 import urllib.parse
 
 import numpy as np
+
+
+def shield_sigpipe() -> None:
+    """Put SIGPIPE back to Python's own default (ignored, so a write
+    to a dead peer raises BrokenPipeError instead of killing us).  A
+    load generator's target dying mid-write is an OUTCOME to record
+    (``lost``), never a reason to die — but the embedded CLIs install
+    SIG_DFL for shell-pipeline manners, and a host process that ran
+    one of their mains would otherwise carry that disposition into
+    the run.  No-op off the main thread."""
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+    except (ValueError, AttributeError):  # non-main thread / platform
+        pass
+
 
 # ------------------------------------------------------------ summaries
 
@@ -90,7 +109,7 @@ def summarize(records: list[dict], duration_s: float, *,
     (served requests per second) vs offered load, shed/timeout rates,
     and the latency summary of *served* requests only."""
     n = len(records)
-    counts = {s: 0 for s in ("ok", "shed", "timeout", "error")}
+    counts = {s: 0 for s in ("ok", "shed", "timeout", "error", "lost")}
     ops: dict[str, int] = {}
     for r in records:
         counts[r["status"]] = counts.get(r["status"], 0) + 1
@@ -109,11 +128,13 @@ def summarize(records: list[dict], duration_s: float, *,
         "shed": counts["shed"],
         "timeout": counts["timeout"],
         "error": counts["error"],
+        "lost": counts["lost"],
         "goodput_rps": round(goodput, 1),
         "goodput_vs_offered": (round(goodput / offered_rps, 4)
                                if offered_rps else None),
         "shed_rate": round(counts["shed"] / n, 4) if n else 0.0,
         "timeout_rate": round(counts["timeout"] / n, 4) if n else 0.0,
+        "lost_rate": round(counts["lost"] / n, 4) if n else 0.0,
         "ops": ops,
         "latency_ms": latency_summary(ok_lat_s),
     }
@@ -228,10 +249,17 @@ class _Client:
     def request(self, kernel: str, rows: int, body: bytes, *,
                 max_retries: int = 2, retry_cap_s: float = 1.0,
                 path: str = "/v1/infer", op: str = "infer") -> dict:
-        """Issue one logical request (with 429 retries); returns its
-        outcome row (latency spans all attempts, sleeps included).
+        """Issue one logical request (with 429/503 retries); returns
+        its outcome row (latency spans all attempts, sleeps included).
         ``path``/``op`` route the mixed-traffic mode: infer requests
-        hit ``/v1/infer``, ingest feeds hit ``/ingest``."""
+        hit ``/v1/infer``, ingest feeds hit ``/ingest``.
+
+        Outcome classes: 429 exhausted -> ``shed`` (the server chose
+        to refuse), 503 exhausted -> ``shed`` too (not-ready/draining
+        is admission control, not failure), 504/timeout ->
+        ``timeout``, connection refused/reset/incomplete response ->
+        ``lost`` (nothing answered — the blast-radius class the chaos
+        drills count), other codes -> ``error``."""
         attempts, code, req_id, status = 0, None, None, "error"
         t_start = time.perf_counter()
         while True:
@@ -242,13 +270,16 @@ class _Client:
                 status, code = "timeout", None
                 break
             except (http.client.HTTPException, OSError):
-                status, code = "error", None
+                # connection-level loss: refused (restart gap), reset
+                # (kill -9 mid-flight), or a torn response — distinct
+                # from shed (429/503) and expired (504)
+                status, code = "lost", None
                 break
             req_id = headers.get("X-Request-Id") or req_id
             if code == 200:
                 status = "ok"
                 break
-            if code == 429:
+            if code in (429, 503):
                 if attempts > max_retries:
                     status = "shed"
                     break
@@ -317,12 +348,18 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
                   max_retries: int = 2, retry_cap_s: float = 1.0,
                   n_workers: int = 16, seed: int = 0,
                   ingest_frac: float = 0.0, n_out: int = 2,
-                  out_path: str | None = None) -> dict:
+                  out_path: str | None = None,
+                  stop: "threading.Event | None" = None,
+                  on_record=None) -> dict:
     """Offered-load run: arrivals are scheduled up front and fired on
     time by a worker pool whether or not earlier requests finished.
     ``ingest_frac`` of the arrivals become ``POST /ingest`` sample
-    feeds (the ``--mix`` mode).  Returns the summary dict (and writes
-    the JSONL to ``out_path``)."""
+    feeds (the ``--mix`` mode).  ``stop`` (an Event) ends the run
+    early — the chaos drills schedule a generous duration and stop
+    once recovery is confirmed; ``on_record`` observes each outcome
+    row as it lands.  Returns the summary dict (and writes the JSONL
+    to ``out_path``)."""
+    shield_sigpipe()
     rng = np.random.RandomState(seed)
     arrivals = make_arrivals(process, rate_rps, duration_s, rng)
     bodies = _request_bodies(kernels, rows_choices, n_in, timeout_s)
@@ -343,13 +380,19 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
         client = _Client(url, timeout_s)
         try:
             while True:
+                if stop is not None and stop.is_set():
+                    return
                 try:
                     t_due, k, r, op = specs.get_nowait()
                 except queue.Empty:
                     return
                 delay = t0 + t_due - time.perf_counter()
                 if delay > 0:
-                    time.sleep(delay)
+                    if stop is not None:
+                        if stop.wait(delay):
+                            return
+                    else:
+                        time.sleep(delay)
                 if op == "ingest":
                     rec = client.request(
                         k, r, feed_bodies[(k, r)],
@@ -363,6 +406,8 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
                 rec["t"] = round(t_due, 6)
                 with rec_lock:
                     records.append(rec)
+                if on_record is not None:
+                    on_record(rec)
         finally:
             client.close()
 
@@ -372,7 +417,9 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
         t.start()
     for t in threads:
         t.join()
-    wall_s = max(time.perf_counter() - t0, duration_s)
+    wall_s = time.perf_counter() - t0
+    if stop is None or not stop.is_set():
+        wall_s = max(wall_s, duration_s)
     summary = summarize(records, wall_s, offered_rps=rate_rps)
     summary["process"] = process
     if out_path:
@@ -391,6 +438,7 @@ def run_closed_loop(url: str, *, n_clients: int = 4,
     """Saturation probe: N clients in sequential request loops for the
     duration.  Offered load equals achieved load by construction.
     ``ingest_frac`` of the requests become ``POST /ingest`` feeds."""
+    shield_sigpipe()
     records: list[dict] = []
     rec_lock = threading.Lock()
     t0 = time.perf_counter()
@@ -557,8 +605,8 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=2.0,
                     help="per-request timeout_s")
     ap.add_argument("--retries", type=int, default=2,
-                    help="max 429 retries per request (0: record "
-                         "the shed)")
+                    help="max 429/503 retries per request (0: "
+                         "record the shed)")
     ap.add_argument("--retry-cap", type=float, default=1.0,
                     help="cap on honored Retry-After sleeps")
     ap.add_argument("--seed", type=int, default=0)
