@@ -808,6 +808,21 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["serve_smoke_error"] = repr(exc)
 
+    # Multi-replica scale-out (tools/bench_serve.py run_bench_replicas):
+    # mixed-load goodput at 1/2/4 router replicas (head-of-line
+    # isolation on CPU threads), the N-replica bitwise-parity proof,
+    # and warm-vs-cold replica boot over the persistent compile cache
+    # (docs/serving.md#scale-out).  HPNN_BENCH_NO_REPLICAS=1 skips it.
+    if not os.environ.get("HPNN_BENCH_NO_REPLICAS"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import bench_serve
+
+            out["replicas"] = bench_serve.run_bench_replicas()
+        except Exception as exc:
+            out["replicas_error"] = repr(exc)
+
     # Load + SLO (tools/loadgen.py run_bench_load): saturation probe,
     # then 2x-saturation open-loop against an SLO-armed shedding
     # server — records goodput vs the plateau and the windowed p99 of
@@ -852,6 +867,21 @@ def main(argv=None) -> None:
             out["drill"] = chaos_drill.run_bench_drill()
         except Exception as exc:
             out["drill_error"] = repr(exc)
+
+    # Replica chaos drill (tools/chaos_drill.py run_bench_replica_drill):
+    # kill one router replica of 3 under open-loop load, prove the
+    # router sheds around it — bounded goodput dip, zero lost requests
+    # after the kill lands on survivors (docs/resilience.md).  Rides
+    # the same HPNN_BENCH_NO_DRILL knob (in-process, a few seconds).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["replica_drill"] = chaos_drill.run_bench_replica_drill()
+        except Exception as exc:
+            out["replica_drill_error"] = repr(exc)
 
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
@@ -923,12 +953,27 @@ def main(argv=None) -> None:
         compact["online_promotions"] = on["promotions"]
         compact["online_promote_latency_ms"] = (
             on["promote_latency_ms"])
+    if "replicas" in out and "goodput" in out["replicas"]:
+        rp = out["replicas"]
+        compact["replica_goodput_rps"] = {
+            k: v["rps"] for k, v in rp["goodput"].items()}
+        compact["replica_scaling_x2"] = rp["scaling_x"].get("r2")
+        compact["replica_parity_ok"] = rp["parity"]["ok"]
+        wb = rp["warm_boot"]
+        compact["replica_warm_hit_rate"] = wb["warm"]["hit_rate"]
+        compact["replica_warm_ready_s"] = wb["warm"]["ready_s"]
+        compact["replica_warm_speedup_x"] = wb["speedup_x"]
     if "drill" in out and out["drill"].get("recovery_s") is not None:
         dr = out["drill"]
         compact["drill_recovery_s"] = dr["recovery_s"]
         compact["drill_goodput_dip_pct"] = dr["goodput_dip_pct"]
         compact["drill_lost_requests"] = dr["lost_requests"]
         compact["drill_restored_bitwise"] = dr["restored_bitwise"]
+    if ("replica_drill" in out
+            and out["replica_drill"].get("goodput_dip_pct") is not None):
+        rd = out["replica_drill"]
+        compact["drill_replica_dip_pct"] = rd["goodput_dip_pct"]
+        compact["drill_replica_survivors_lost"] = rd["survivors_lost"]
     if "obs_overhead" in out:
         compact["obs_overhead_pct"] = (
             out["obs_overhead"]["paired_overhead_pct"]["median"]

@@ -111,12 +111,13 @@ class OnlineSession:
             ws, rec = restored
             ckpt = os.path.join(self.wal.dir, rec["ckpt"])
             st = os.stat(ckpt)
-            entry = self.serve.registry.register(
+            # the serve-level call (not raw registry+engine) so a
+            # multi-replica Router fans the restore to every replica
+            entry = self.serve.register_kernel(
                 name, kernel_mod.Kernel(weights=ws),
-                model=rec.get("model", model), path=ckpt,
-                mtime=st.st_mtime, sig=(st.st_mtime_ns, st.st_size))
-            if warmup:
-                self.serve.engine.warmup([name])
+                model=rec.get("model", model), warmup=warmup,
+                path=ckpt, mtime=st.st_mtime,
+                sig=(st.st_mtime_ns, st.st_size))
             self.restored[name] = int(rec.get("version", 0))
             obs.event("online.restore", kernel=name,
                       wal_version=int(rec.get("version", 0)),

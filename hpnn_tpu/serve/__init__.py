@@ -15,16 +15,27 @@ zero steady-state compiles.  Layers, bottom up:
 * :mod:`~hpnn_tpu.serve.batcher` — bounded coalescing queue with
   deadlines, explicit backpressure, and SLO-driven load shedding;
 * :mod:`~hpnn_tpu.serve.server` — :class:`Session` (the in-process
-  embedding API) and the stdlib HTTP front end.
+  embedding API) and the stdlib HTTP front end;
+* :mod:`~hpnn_tpu.serve.replica` / :mod:`~hpnn_tpu.serve.router` —
+  data-parallel scale-out: N device-pinned Session replicas behind a
+  least-outstanding-requests router with shed/unready awareness, a
+  TP spill-over path for oversized row blocks, and fence-ordered
+  promotion fan-out (docs/serving.md#scale-out);
+* :mod:`~hpnn_tpu.serve.compile_cache` — the persistent XLA
+  executable cache (``HPNN_COMPILE_CACHE_DIR``) that turns replica
+  spin-up warmups into disk reads.
 
 ``import hpnn_tpu.serve`` is jax-free (stdlib + numpy); jax loads on
 the first compile, same discipline as ``hpnn_tpu.obs``.  Architecture
 and semantics: docs/serving.md.
 """
 
+from hpnn_tpu.serve import compile_cache
 from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull, Shed
 from hpnn_tpu.serve.engine import Engine, bucket_for, bucket_menu
 from hpnn_tpu.serve.registry import Entry, Registry, RegistryError
+from hpnn_tpu.serve.replica import Replica
+from hpnn_tpu.serve.router import Router
 from hpnn_tpu.serve.server import Session, install_drain, make_server
 
 __all__ = [
@@ -38,7 +49,10 @@ __all__ = [
     "Entry",
     "Registry",
     "RegistryError",
+    "Replica",
+    "Router",
     "Session",
+    "compile_cache",
     "install_drain",
     "make_server",
 ]

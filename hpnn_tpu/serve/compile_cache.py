@@ -1,0 +1,170 @@
+"""Persistent XLA executable cache for fast replica spin-up.
+
+ROADMAP item 4's first slice: every serve executable already has a
+stable identity (``serve.<kernel>.v<V>.b<B>`` — obs/cost.py), so the
+JAX persistent compilation cache can key compiled executables across
+process boundaries.  When ``HPNN_COMPILE_CACHE_DIR`` is set, the
+engine arms this module lazily on its first real compile; from then on
+every lowering consults the on-disk cache before invoking XLA, and a
+replica booting against a warm directory pre-warms its whole bucket
+menu from disk instead of recompiling it (docs/serving.md#scale-out).
+
+Hits and misses are surfaced three ways:
+
+* obs counters ``serve.compile_warm_hit`` / ``serve.compile_warm_miss``
+  (one per executable lookup), fed by a ``jax.monitoring`` listener;
+* process-wide counters behind :func:`counters` for benchmarks;
+* the ``/healthz compile_cache`` document gains a ``persistent``
+  section (:func:`stats`): dir, hit/miss totals, hit rate, on-disk
+  entry count + bytes.
+
+Unset knob → everything here is a no-op and jax is never imported:
+``import hpnn_tpu.serve`` stays jax-free.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from hpnn_tpu import obs
+
+ENV_DIR = "HPNN_COMPILE_CACHE_DIR"
+
+_lock = threading.Lock()
+_armed = False
+_dir: str | None = None
+_hits = 0
+_misses = 0
+_listener_registered = False
+
+# jax.monitoring event names for compilation-cache lookups
+_EV_HIT = "/jax/compilation_cache/cache_hits"
+_EV_MISS = "/jax/compilation_cache/cache_misses"
+
+
+def configured_dir() -> str | None:
+    """The knob value, or None when persistence is off."""
+    return os.environ.get(ENV_DIR) or None
+
+
+def _on_event(event: str, **kwargs) -> None:
+    global _hits, _misses
+    if not _armed:
+        return
+    if event == _EV_HIT:
+        with _lock:
+            _hits += 1
+        obs.count("serve.compile_warm_hit")
+    elif event == _EV_MISS:
+        with _lock:
+            _misses += 1
+        obs.count("serve.compile_warm_miss")
+
+
+def arm() -> bool:
+    """Point jax's persistent compilation cache at the knob directory.
+
+    Idempotent and cheap to call before every compile; returns True
+    when the cache is (now) armed, False when the knob is unset.  The
+    thresholds are dropped to zero so even sub-millisecond CPU-parity
+    executables persist — replica spin-up wants *every* bucket warm,
+    not just the slow ones.  Re-arming after the knob changed re-points
+    jax at the new directory (tests do this with tmp dirs).
+    """
+    global _armed, _dir, _listener_registered
+    d = configured_dir()
+    if d is None:
+        return False
+    with _lock:
+        fresh = (not _armed) or (_dir != d)
+        _armed = True
+        _dir = d
+    if fresh:
+        import jax
+
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+        # jax latches the cache decision at its FIRST compile: a
+        # process that compiled anything before arming keeps the
+        # cache off until reset.  The reset hook is private but load-
+        # bearing here; degrade to cold compiles if it moves.
+        try:
+            from jax._src import compilation_cache as _jax_cc
+
+            _jax_cc.reset_cache()
+        except Exception:
+            pass
+        with _lock:
+            if not _listener_registered:
+                jax.monitoring.register_event_listener(_on_event)
+                _listener_registered = True
+    return True
+
+
+def counters() -> tuple[int, int]:
+    """(hits, misses) observed by this process since arming."""
+    with _lock:
+        return _hits, _misses
+
+
+def hit_rate() -> float | None:
+    """Warm-start hit rate in [0, 1]; None before any lookup."""
+    h, m = counters()
+    return (h / (h + m)) if (h + m) else None
+
+
+def stats() -> dict | None:
+    """The ``/healthz compile_cache.persistent`` section, or None
+    when the knob is unset (section omitted entirely)."""
+    d = configured_dir()
+    if d is None and not _armed:
+        return None
+    h, m = counters()
+    doc = {
+        "dir": _dir or d,
+        "armed": _armed,
+        "hits": h,
+        "misses": m,
+        "hit_rate": hit_rate(),
+        "entries": 0,
+        "bytes": 0,
+    }
+    scan = doc["dir"]
+    if scan and os.path.isdir(scan):
+        try:
+            with os.scandir(scan) as it:
+                for e in it:
+                    if e.is_file():
+                        doc["entries"] += 1
+                        doc["bytes"] += e.stat().st_size
+        except OSError:
+            pass
+    return doc
+
+
+def _reset_for_tests() -> None:
+    """Zero counters and disarm (the jax monitoring listener stays
+    registered — it is a no-op while disarmed)."""
+    global _armed, _dir, _hits, _misses
+    import sys
+
+    was_armed = _armed
+    with _lock:
+        _armed = False
+        _dir = None
+        _hits = 0
+        _misses = 0
+    if was_armed and "jax" in sys.modules:
+        sys.modules["jax"].config.update("jax_compilation_cache_dir",
+                                         None)
+        try:
+            from jax._src import compilation_cache as _jax_cc
+
+            _jax_cc.reset_cache()
+        except Exception:
+            pass

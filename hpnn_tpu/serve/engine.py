@@ -46,6 +46,7 @@ is asserted identically.  jax is imported lazily inside the class so
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
 import threading
@@ -54,6 +55,7 @@ import time
 import numpy as np
 
 from hpnn_tpu import chaos, obs
+from hpnn_tpu.serve import compile_cache
 from hpnn_tpu.serve.registry import Entry, Registry
 
 DEFAULT_MAX_BATCH = 64
@@ -112,7 +114,8 @@ class Engine:
     def __init__(self, registry: Registry, *,
                  max_batch: int = DEFAULT_MAX_BATCH,
                  n_buckets: int = DEFAULT_N_BUCKETS,
-                 mode: str | None = None):
+                 mode: str | None = None,
+                 device_index: int | None = None):
         if mode is None:
             mode = os.environ.get("HPNN_SERVE_MODE") or None
         if mode is not None and mode not in _MODES:
@@ -122,6 +125,12 @@ class Engine:
         self.max_batch = int(max_batch)
         self.buckets = bucket_menu(max_batch, n_buckets)
         self._mode = mode          # resolved lazily: needs the backend
+        # replica pinning (serve/replica.py): weights + executables for
+        # this engine live on jax.local_devices()[device_index % n] —
+        # N engines spread the registry across N chips.  None (the
+        # single-engine default) keeps jax's own placement.  Parity
+        # mode runs host closures, so the pin is a no-op there.
+        self.device_index = device_index
         self._lock = threading.Lock()
         self._compiled: dict[tuple, object] = {}
         self._weights_cache: dict[tuple, tuple] = {}
@@ -141,16 +150,32 @@ class Engine:
         return self._mode
 
     # ------------------------------------------------------------ compile
+    def _device(self):
+        """The pinned jax device, or None when unpinned."""
+        if self.device_index is None:
+            return None
+        import jax
+
+        local = jax.local_devices()
+        return local[self.device_index % len(local)]
+
     def _device_weights(self, entry: Entry):
-        """Entry weights as device arrays, cached per (name, version)."""
+        """Entry weights as device arrays, cached per (name, version);
+        placed on the pinned replica device when one is set."""
+        import jax
         import jax.numpy as jnp
 
         key = (entry.name, entry.version)
         with self._lock:
             w = self._weights_cache.get(key)
         if w is None:
-            w = tuple(jnp.asarray(np.asarray(a)) for a in
-                      entry.kernel.weights)
+            dev = self._device()
+            if dev is not None:
+                w = tuple(jax.device_put(np.asarray(a), dev) for a in
+                          entry.kernel.weights)
+            else:
+                w = tuple(jnp.asarray(np.asarray(a)) for a in
+                          entry.kernel.weights)
             with self._lock:
                 self._weights_cache[key] = w
         return w
@@ -191,6 +216,10 @@ class Engine:
                     return np.stack(
                         [np.asarray(_run(_w, x)) for x in xs])
         else:
+            # arm the persistent executable cache before lowering so a
+            # warm HPNN_COMPILE_CACHE_DIR turns this compile into a
+            # disk read (serve/compile_cache.py; no-op when unset)
+            compile_cache.arm()
             weights = self._device_weights(entry)
             def batch_forward(xs):
                 return jax.vmap(lambda x: model.run(weights, x))(xs)
@@ -201,10 +230,14 @@ class Engine:
             donate = () if jax.default_backend() == "cpu" else (0,)
             shape = jax.ShapeDtypeStruct((bucket, entry.n_inputs),
                                          dtype)
+            dev = self._device()
             with obs.timer("serve.compile_time", kernel=entry.name,
                            bucket=bucket):
-                # the same HIGHEST matmul pin as batch.make_eval_fn
-                with jax.default_matmul_precision("float32"):
+                # the same HIGHEST matmul pin as batch.make_eval_fn;
+                # a pinned replica compiles for its own device
+                with jax.default_matmul_precision("float32"), \
+                        (jax.default_device(dev) if dev is not None
+                         else contextlib.nullcontext()):
                     fn = (jax.jit(batch_forward, donate_argnums=donate)
                           .lower(shape).compile())
         fill_s = time.perf_counter() - t_fill
@@ -268,6 +301,13 @@ class Engine:
                 n += 1
         obs.event("serve.warmup", kernels=len(names),
                   buckets=len(self.buckets))
+        # warm-start hit rate across the menu just compiled: 1.0 means
+        # every executable came off disk (HPNN_COMPILE_CACHE_DIR), 0.0
+        # means a fully cold boot — the replica spin-up cost signal
+        rate = compile_cache.hit_rate()
+        if rate is not None:
+            obs.gauge("serve.compile_warm_rate", rate,
+                      kernels=len(names))
         return n
 
     # ------------------------------------------------------------ run
@@ -391,6 +431,7 @@ class Engine:
         else:
             import jax.numpy as jnp
 
+            compile_cache.arm()
             stacked = tuple(
                 jnp.stack([jnp.asarray(np.asarray(e.kernel.weights[l]))
                            for e in entries])
@@ -405,9 +446,12 @@ class Engine:
             donate = () if jax.default_backend() == "cpu" else (0,)
             shape = jax.ShapeDtypeStruct(
                 (len(entries), bucket, first.n_inputs), dtype)
+            dev = self._device()
             with obs.timer("serve.compile_time", kernel="(fleet)",
                            bucket=bucket, members=len(entries)):
-                with jax.default_matmul_precision("float32"):
+                with jax.default_matmul_precision("float32"), \
+                        (jax.default_device(dev) if dev is not None
+                         else contextlib.nullcontext()):
                     fn = (jax.jit(fleet_forward, donate_argnums=donate)
                           .lower(shape).compile())
         fill_s = time.perf_counter() - t_fill

@@ -83,17 +83,24 @@ class Registry:
     def register(
         self, name: str, kernel: kernel_mod.Kernel, *, model: str = "ann",
         path: str | None = None, mtime: float | None = None,
-        sig: tuple | None = None,
+        sig: tuple | None = None, version: int | None = None,
     ) -> Entry:
-        """Install (or replace) ``name`` with in-memory weights."""
+        """Install (or replace) ``name`` with in-memory weights.
+
+        ``version`` pins the entry's version instead of auto-bumping —
+        a freshly spun-up serving replica mirrors another registry and
+        must agree on versions so the engines' executable identities
+        (``serve.<kernel>.v<V>.b<B>``) line up across the fleet
+        (serve/router.py)."""
         _check_model(model)
         if not kernel_mod.validate(kernel):
             raise RegistryError(f"kernel {name!r} failed validation")
         with self._lock:
             prev = self._entries.get(name)
-            version = prev.version + 1 if prev is not None else 0
-            entry = Entry(name, kernel, model, version, path, mtime,
-                          sig)
+            if version is None:
+                version = prev.version + 1 if prev is not None else 0
+            entry = Entry(name, kernel, model, int(version), path,
+                          mtime, sig)
             self._entries[name] = entry
         obs.count("serve.kernel_load", kernel=name, version=version,
                   source="file" if path else "memory")

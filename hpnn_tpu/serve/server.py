@@ -61,6 +61,7 @@ import numpy as np
 
 from hpnn_tpu import obs
 from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.serve import compile_cache
 from hpnn_tpu.serve.batcher import (Batcher, DeadlineExceeded, QueueFull,
                                     Shed)
 from hpnn_tpu.serve.engine import (DEFAULT_MAX_BATCH, DEFAULT_N_BUCKETS,
@@ -92,10 +93,12 @@ class Session:
                  shed_age_ms: float | None = None,
                  shed_p99_ms: float | None = None,
                  clock=time.monotonic, start: bool = True,
-                 mode: str | None = None, fleet: bool | None = None):
+                 mode: str | None = None, fleet: bool | None = None,
+                 device_index: int | None = None):
         self.registry = Registry()
         self.engine = Engine(self.registry, max_batch=max_batch,
-                             n_buckets=n_buckets, mode=mode)
+                             n_buckets=n_buckets, mode=mode,
+                             device_index=device_index)
         self.max_wait_ms = float(max_wait_ms)
         self.max_depth = int(max_depth)
         self.shed_age_ms = shed_age_ms    # None → batcher reads env
@@ -132,9 +135,16 @@ class Session:
         return entry
 
     def register_kernel(self, name: str, kernel: kernel_mod.Kernel, *,
-                        model: str = "ann", warmup: bool = True):
-        """Install in-memory weights (no file backing, no hot-reload)."""
-        entry = self.registry.register(name, kernel, model=model)
+                        model: str = "ann", warmup: bool = True,
+                        path: str | None = None,
+                        mtime: float | None = None,
+                        sig: tuple | None = None):
+        """Install in-memory weights.  ``path``/``mtime``/``sig`` give
+        the entry a reload source (the online WAL-restore path hands a
+        checkpoint here); without them there is no file backing and no
+        hot-reload."""
+        entry = self.registry.register(name, kernel, model=model,
+                                       path=path, mtime=mtime, sig=sig)
         if warmup:
             self.engine.warmup([name])
         return entry
@@ -200,6 +210,12 @@ class Session:
         shed/expired counters, and the SLO verdict (obs/slo.py)."""
         with self._lock:
             batchers = dict(self._batchers)
+        cache = self.engine.cache_stats()
+        persistent = compile_cache.stats()
+        if persistent is not None:
+            # the cross-process executable cache census — present only
+            # when HPNN_COMPILE_CACHE_DIR is set (docs/serving.md)
+            cache["persistent"] = persistent
         doc = {
             "status": "ok",
             "live": True,
@@ -208,7 +224,7 @@ class Session:
             "kernels": self.registry.names(),
             "buckets": list(self.engine.buckets),
             "compiled": self.engine.compiled_count(),
-            "compile_cache": self.engine.cache_stats(),
+            "compile_cache": cache,
             "batchers": {
                 name: {"depth": b.depth(),
                        "oldest_wait_s": b.oldest_age(),

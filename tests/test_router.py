@@ -1,0 +1,452 @@
+"""Multi-replica serving scale-out (hpnn_tpu/serve/router.py,
+docs/serving.md "Scale-out").
+
+Acceptance bar (ISSUE): a Router over N replicas answers every
+registry kernel **bitwise-identically** to a single-replica Session;
+a promotion fanned out mid-traffic is seen by every request as
+bitwise old-version or new-version, never a torn mix; unready /
+killed / shedding replicas are routed around without losing requests;
+oversized row blocks spill to the TP path; a replica booting against
+a warm ``HPNN_COMPILE_CACHE_DIR`` records persistent-cache hits in
+the ``/healthz`` document; and the whole obs surface passes the
+``tools/check_obs_catalog.py --serve-replicas`` schema lint.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import serve
+from hpnn_tpu.models import ann, kernel as kernel_mod, snn
+from hpnn_tpu.serve.batcher import Shed
+from hpnn_tpu.serve.router import Router
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _kernel(seed=7, n_in=8, hiddens=(5,), n_out=2):
+    k, _ = kernel_mod.generate(seed, n_in, list(hiddens), n_out)
+    return k
+
+
+def _read_sink(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+# --------------------------------------------------------------- parity
+def test_n_replica_parity_every_registry_kernel():
+    """The scale-out contract: for EVERY registry kernel (ann + snn),
+    a 3-replica Router answers bitwise-identically to a
+    single-replica Session across single vectors and row blocks."""
+    router = Router(3, max_batch=16, max_wait_ms=0.5)
+    single = serve.Session(max_batch=16, max_wait_ms=0.5)
+    try:
+        specs = [("a", _kernel(seed=7), "ann"),
+                 ("s", _kernel(seed=20), "snn")]
+        for name, k, model in specs:
+            router.register_kernel(name, k, model=model)
+            single.register_kernel(name, k, model=model)
+        rng = np.random.RandomState(3)
+        for name, _k, _model in specs:
+            vec = rng.uniform(-1, 1, 8)
+            assert np.array_equal(router.infer(name, vec),
+                                  single.infer(name, vec))
+            for rows in (1, 3, 8, 21):
+                X = rng.uniform(-1, 1, (rows, 8))
+                assert np.array_equal(router.infer(name, X),
+                                      single.infer(name, X))
+    finally:
+        router.close()
+        single.close()
+
+
+def test_router_is_session_shaped():
+    """The Session surface callers rely on: kernels(), health() doc
+    shape, ready_doc(), registry/engine facades."""
+    router = Router(2, max_batch=8, max_wait_ms=0.5)
+    try:
+        router.register_kernel("k", _kernel())
+        assert router.kernels() == ["k"]
+        assert router.registry.get("k").version == 0
+        assert router.engine.buckets == \
+            router.replicas[0].engine.buckets
+        assert router.is_ready()
+        doc = router.health()
+        assert doc["ready"] is True
+        assert doc["router"]["n_replicas"] == 2
+        assert doc["router"]["live_replicas"] == 2
+        assert set(doc["replicas"]) == {"r0", "r1"}
+        for rdoc in doc["replicas"].values():
+            assert rdoc["ready"] is True
+            assert rdoc["outstanding"] == 0
+        # batchers are replica-prefixed the way training sinks are
+        assert all(name.startswith(("r0/", "r1/"))
+                   for name in doc["batchers"])
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------- fence
+def test_promotion_fence_old_or_new_never_torn():
+    """Install a new version while requests stream: every answer must
+    be bitwise old-version or bitwise new-version output."""
+    k_old, k_new = _kernel(seed=7), _kernel(seed=11)
+    router = Router(3, max_batch=16, max_wait_ms=0.5)
+    try:
+        router.register_kernel("k", k_old)
+        X = np.linspace(-1.0, 1.0, 24).reshape(3, 8)
+        out_old = np.stack([np.asarray(ann.run(k_old.weights, x))
+                            for x in X])
+        out_new = np.stack([np.asarray(ann.run(k_new.weights, x))
+                            for x in X])
+        assert not np.array_equal(out_old, out_new)
+
+        stop = threading.Event()
+        torn: list = []
+
+        def infer_loop():
+            while not stop.is_set():
+                out = np.asarray(router.infer("k", X))
+                if not (np.array_equal(out, out_old)
+                        or np.array_equal(out, out_new)):
+                    torn.append(out)
+                    return
+
+        threads = [threading.Thread(target=infer_loop)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for k in (k_new, k_old, k_new):  # three promotions mid-flight
+            router.install_kernel("k", k)
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert torn == [], "a request saw a torn old/new weight mix"
+        assert router.registry.get("k").version == 3
+        # converged: every live replica agrees on the version
+        assert {rep.registry.get("k").version
+                for rep in router.replicas} == {3}
+    finally:
+        router.close()
+
+
+# -------------------------------------------------------------- routing
+def test_unready_replica_is_routed_around(tmp_path):
+    from hpnn_tpu import obs
+
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        router = Router(2, max_batch=8, max_wait_ms=0.5)
+        router.register_kernel("k", _kernel())
+        router.replicas[0].mark_unready("draining")
+        assert router.is_ready()          # one survivor keeps the edge
+        for _ in range(5):
+            router.infer("k", np.zeros(8))
+        router.replicas[0].mark_ready()
+        router.close()
+    finally:
+        obs.configure(None)
+    routes = [r for r in _read_sink(sink) if r["ev"] == "router.route"]
+    assert routes and all(r["rank"] == 1 for r in routes)
+
+
+def test_kill_replica_survivors_answer_bitwise(tmp_path):
+    """kill_replica takes a replica out of rotation; survivors keep
+    answering bitwise and a later promotion reaches only the living
+    (the dead replica's frozen registry must not poison reads)."""
+    router = Router(3, max_batch=8, max_wait_ms=0.5)
+    try:
+        k0 = _kernel(seed=7)
+        router.register_kernel("k", k0)
+        probe = np.linspace(-1.0, 1.0, 8)
+        before = np.asarray(router.infer("k", probe))
+        router.kill_replica(0)
+        doc = router.health()
+        assert doc["router"]["live_replicas"] == 2
+        assert doc["replicas"]["r0"]["status"] == "closed"
+        assert router.is_ready()
+        assert np.array_equal(router.infer("k", probe), before)
+        # promotion after the kill lands on survivors only
+        k1 = _kernel(seed=11)
+        router.install_kernel("k", k1)
+        assert router.registry.get("k").version == 1
+        expect = np.asarray(ann.run(k1.weights, probe))
+        assert np.array_equal(router.infer("k", probe), expect)
+    finally:
+        router.close()
+
+
+def test_shed_reroutes_and_cools_the_replica(tmp_path):
+    """A replica that sheds is routed around — the request lands on
+    the next-best replica — and cools off for its retry_after_s, so
+    follow-up requests skip it without even asking."""
+    from hpnn_tpu import obs
+
+    sink = tmp_path / "obs.jsonl"
+    router = Router(2, max_batch=8, max_wait_ms=0.5)
+    try:
+        router.register_kernel("k", _kernel())
+        real_infer = router.replicas[0].infer
+
+        def shedding_infer(name, x, **kw):
+            raise Shed("saturated", reason="queue_age",
+                       retry_after_s=30.0)
+
+        router.replicas[0].infer = shedding_infer
+        obs.configure(str(sink))
+        try:
+            out = router.infer("k", np.zeros(8))   # rerouted, answered
+            assert np.asarray(out).shape == (2,)
+            for _ in range(3):                     # r0 cooling: skipped
+                router.infer("k", np.zeros(8))
+        finally:
+            obs.configure(None)
+        router.replicas[0].infer = real_infer
+        recs = _read_sink(sink)
+        sheds = [r for r in recs if r["ev"] == "router.shed_around"]
+        assert len(sheds) == 1 and sheds[0]["rank"] == 0
+        assert sheds[0]["reason"] == "queue_age"
+        routes = [r for r in recs if r["ev"] == "router.route"]
+        assert [r["rank"] for r in routes].count(0) == 1  # one attempt
+        assert all(r["rank"] == 1 for r in routes[1:])
+        assert router.health()["replicas"]["r0"]["cooling"] is True
+    finally:
+        router.close()
+
+
+def test_all_replicas_refusing_raises_shed():
+    router = Router(2, max_batch=8, max_wait_ms=0.5)
+    try:
+        router.register_kernel("k", _kernel())
+        router.mark_unready("maintenance")
+        assert not router.is_ready()
+        with pytest.raises(Shed):
+            router.infer("k", np.zeros(8))
+        with pytest.raises(KeyError):
+            router.infer("nope", np.zeros(8))
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- spin-up
+def test_spawn_replica_pins_versions_and_answers():
+    router = Router(2, max_batch=8, max_wait_ms=0.5)
+    try:
+        router.register_kernel("k", _kernel(seed=7))
+        k1 = _kernel(seed=11)
+        router.install_kernel("k", k1)       # every replica at v1
+        rep = router.spawn_replica()
+        assert rep.rank == 2
+        assert rep.registry.get("k").version == 1   # pinned, not 0
+        probe = np.linspace(-1.0, 1.0, 8)
+        expect = np.asarray(ann.run(k1.weights, probe))
+        # the spawned replica answers identically through the router
+        for _ in range(6):
+            assert np.array_equal(router.infer("k", probe), expect)
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- TP spill
+def test_tp_spillover_for_oversized_row_blocks(tmp_path):
+    """Row blocks exceeding the bucket menu spill to the TP batched
+    forward (parallel/tp.py) instead of chunking through one
+    replica's largest bucket."""
+    from hpnn_tpu import obs
+
+    sink = tmp_path / "obs.jsonl"
+    router = Router(2, max_batch=8, n_buckets=1, max_wait_ms=0.5,
+                    spill=True)
+    try:
+        k = _kernel(seed=9)
+        router.register_kernel("k", k)
+        X = np.random.RandomState(5).uniform(-1, 1, (24, 8))
+        obs.configure(str(sink))
+        try:
+            out = np.asarray(router.infer("k", X))
+        finally:
+            obs.configure(None)
+        assert out.shape == (24, 2)
+        ref = np.stack([np.asarray(ann.run(k.weights, x)) for x in X])
+        # TP numerics, not the parity engine's bitwise contract
+        np.testing.assert_allclose(out, ref, rtol=1e-10, atol=1e-12)
+        recs = _read_sink(sink)
+        spills = [r for r in recs if r["ev"] == "router.spill"]
+        assert spills and spills[0]["rows"] == 24
+        assert any(r["ev"] == "router.spill_time" for r in recs)
+        assert "k" in router.health()["router"]["spilled_kernels"]
+    finally:
+        router.close()
+
+
+# -------------------------------------------------------- compile cache
+def test_persistent_compile_cache_warm_boot(tmp_path):
+    """A replica booting against a warm HPNN_COMPILE_CACHE_DIR reads
+    executables off disk: warm-hit counters move and /healthz grows
+    the compile_cache.persistent section."""
+    from hpnn_tpu.serve import compile_cache
+
+    cache_dir = str(tmp_path / "xla")
+    os.environ[compile_cache.ENV_DIR] = cache_dir
+    compile_cache._reset_for_tests()
+    try:
+        cold = Router(1, max_batch=8, n_buckets=1, max_wait_ms=0.5,
+                      mode="compiled")
+        cold.register_kernel("k", _kernel(seed=9))
+        expect = np.asarray(cold.infer("k", np.zeros(8)))
+        cold.close()
+        assert os.path.isdir(cache_dir) and os.listdir(cache_dir)
+
+        compile_cache._reset_for_tests()      # simulate a new process
+        os.environ[compile_cache.ENV_DIR] = cache_dir
+        warm = Router(1, max_batch=8, n_buckets=1, max_wait_ms=0.5,
+                      mode="compiled")
+        warm.register_kernel("k", _kernel(seed=9))
+        hits, _misses = compile_cache.counters()
+        assert hits > 0, "warm boot never hit the persistent cache"
+        rate = compile_cache.hit_rate()
+        assert rate is not None and rate > 0
+        doc = warm.health()
+        persistent = doc["compile_cache"]["persistent"]
+        assert persistent["dir"] == cache_dir
+        assert persistent["hits"] == hits
+        assert persistent["entries"] > 0 and persistent["bytes"] > 0
+        # warm executables answer bitwise like the cold ones
+        assert np.array_equal(warm.infer("k", np.zeros(8)), expect)
+        warm.close()
+    finally:
+        os.environ.pop(compile_cache.ENV_DIR, None)
+        compile_cache._reset_for_tests()
+
+
+def test_cache_unarmed_without_knob():
+    from hpnn_tpu.serve import compile_cache
+
+    compile_cache._reset_for_tests()
+    assert compile_cache.configured_dir() is None
+    assert compile_cache.arm() is False
+    assert compile_cache.stats() is None
+    sess = serve.Session(max_batch=8, max_wait_ms=0.5)
+    try:
+        sess.register_kernel("k", _kernel())
+        assert "persistent" not in sess.health()["compile_cache"]
+    finally:
+        sess.close()
+
+
+# ------------------------------------------------------------- obs lint
+def test_router_sink_passes_serve_replicas_lint(tmp_path):
+    """Drive the full router surface with the sink armed, then run
+    tools/check_obs_catalog.py lint_serve_replicas over the records —
+    the frozen-schema proof for the router.* / replica.* family."""
+    from hpnn_tpu import obs
+
+    spec = importlib.util.spec_from_file_location(
+        "check_obs_catalog",
+        os.path.join(ROOT, "tools", "check_obs_catalog.py"))
+    lint_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint_mod)
+
+    sink = tmp_path / "obs.jsonl"
+    obs.configure(str(sink))
+    try:
+        router = Router(3, max_batch=8, max_wait_ms=0.5)
+        router.register_kernel("k", _kernel())
+        rng = np.random.RandomState(1)
+        for rows in (1, 4, 7):
+            router.infer("k", rng.uniform(-1, 1, (rows, 8)))
+        router.infer("k", np.zeros(8))
+        real_infer = router.replicas[0].infer
+
+        def _shed(*_a, **_kw):
+            raise Shed("busy", reason="queue_age", retry_after_s=0.01)
+
+        router.replicas[0].infer = _shed
+        router.infer("k", np.zeros(8))        # shed_around record
+        router.replicas[0].infer = real_infer
+        router.install_kernel("k", _kernel(seed=11))  # fence record
+        router.kill_replica(2)                # replica_down record
+        router.spawn_replica()                # replica_up record
+        router.infer("k", np.zeros(8))
+        router.close()
+    finally:
+        obs.configure(None)
+    failures = lint_mod.lint_serve_replicas(str(sink))
+    assert failures == [], failures
+    evs = {r["ev"] for r in _read_sink(sink)}
+    assert {"router.route", "router.shed_around", "router.fence",
+            "router.replica_down", "router.replica_up",
+            "replica.outstanding"} <= evs
+
+
+def test_lint_serve_replicas_bites_on_bad_records(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "check_obs_catalog",
+        os.path.join(ROOT, "tools", "check_obs_catalog.py"))
+    lint_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint_mod)
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"ev": "router.route", "kind": "count", "rank": -1,
+         "kernel": "", "rows": 0}) + "\n" + json.dumps(
+        {"ev": "replica.outstanding", "kind": "gauge", "rank": 0,
+         "value": -3.0}) + "\n")
+    failures = lint_mod.lint_serve_replicas(str(bad))
+    assert len(failures) >= 4
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"ev": "serve.request"}) + "\n")
+    assert lint_mod.lint_serve_replicas(str(empty))
+
+
+# -------------------------------------------------------- env + HTTP
+def test_router_replica_count_from_env(monkeypatch):
+    monkeypatch.setenv("HPNN_SERVE_REPLICAS", "3")
+    router = Router(max_batch=8, max_wait_ms=0.5)
+    try:
+        assert len(router.replicas) == 3
+    finally:
+        router.close()
+    with pytest.raises(ValueError):
+        Router(0)
+
+
+def test_http_front_end_over_router():
+    """make_server works unchanged over a Router: infer round-trips,
+    /healthz carries the router section, /readyz follows replica
+    readiness."""
+    import http.client
+
+    router = Router(2, max_batch=8, max_wait_ms=0.5)
+    server = serve.make_server(router)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        router.register_kernel("k", _kernel())
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        body = json.dumps({"kernel": "k",
+                           "inputs": [0.0] * 8}).encode()
+        conn.request("POST", "/v1/infer", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 200 and len(doc["outputs"]) == 2
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        hdoc = json.loads(resp.read())
+        assert resp.status == 200
+        assert hdoc["router"]["n_replicas"] == 2
+        conn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        router.close()

@@ -78,6 +78,22 @@ GATE_METRICS = {
     "drill_recovery_s": ("lower", 1.50),
     "drill_goodput_dip_pct": ("lower", 1.00),
     "drill_lost_requests": ("lower", 2.00),
+    # multi-replica scale-out fold-in (tools/bench_serve.py
+    # run_bench_replicas): goodput scaling at 2 replicas vs 1 on the
+    # mixed light/heavy load (head-of-line isolation — the acceptance
+    # floor is 1.7x, the gate guards the measured trajectory), and the
+    # warm persistent-compile-cache boot: hit rate against a warm
+    # HPNN_COMPILE_CACHE_DIR and time-to-ready, both direction-aware
+    "replica_scaling_x2": ("higher", 0.30),
+    "replica_warm_hit_rate": ("higher", 0.50),
+    "replica_warm_ready_s": ("lower", 1.00),
+    "replica_warm_speedup_x": ("higher", 0.50),
+    # replica chaos drill (tools/chaos_drill.py drill_replica): kill
+    # one of N router replicas under load; the goodput dip must stay
+    # bounded and survivors must lose nothing (survivors_lost rides
+    # the zero-baseline skip rule like drill_lost_requests)
+    "drill_replica_dip_pct": ("lower", 1.00),
+    "drill_replica_survivors_lost": ("lower", 2.00),
 }
 
 

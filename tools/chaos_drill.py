@@ -26,12 +26,23 @@ catalog (docs/resilience.md):
 * **sentinel** — ``HPNN_CHAOS="nan@train.round"`` corrupts every
   trained candidate; the promotion gate's sentinel must reject all of
   them while serving stays clean (version pinned, zero lost).
+* **replica** — multi-replica scale-out under fire: an in-process
+  :class:`~hpnn_tpu.serve.router.Router` over N replicas behind the
+  real HTTP front end, loadgen traffic flowing, then
+  ``kill_replica(0)`` mid-stream.  Asserts the router routes around
+  the corpse (goodput dips boundedly, recovers), that NO request
+  arriving after the kill settles is lost (``survivors_lost`` — the
+  router's route-around is supposed to make a replica death invisible
+  at the edge), and that survivors answer bitwise-identically to the
+  pre-kill fleet.
 
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
-``drill.reload`` | ``drill.sentinel``; :func:`run_bench_drill` is the
-bench.py fold-in (compact keys ``drill_recovery_s`` /
-``drill_goodput_dip_pct`` / ``drill_lost_requests``, gated by
-``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
+``drill.reload`` | ``drill.sentinel`` | ``drill.replica``;
+:func:`run_bench_drill` / :func:`run_bench_replica_drill` are the
+bench.py fold-ins (compact keys ``drill_recovery_s`` /
+``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
+``drill_replica_dip_pct`` / ``drill_replica_survivors_lost``, gated
+by ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --drill kill9
@@ -519,10 +530,65 @@ def drill_sentinel(workdir: str, *, rate: float = 40.0,
         child.terminate()
 
 
+def drill_replica(workdir: str, *, rate: float = 80.0,
+                  n_replicas: int = 3, seed: int = 3) -> dict:
+    """Kill one of N router replicas under load: an in-process
+    Router behind ``make_server``, loadgen flowing, then
+    ``kill_replica(0)``.  The route-around contract: bounded goodput
+    dip, full recovery, zero lost requests among arrivals after the
+    kill settles, and bitwise-identical answers from survivors."""
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve import make_server
+    from hpnn_tpu.serve.router import Router
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.replica", "ok": False,
+                 "replicas": n_replicas, "killed_rank": 0}
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    probe = np.linspace(-1.0, 1.0, 8)
+    router = Router(n_replicas, max_batch=16, max_wait_ms=0.5)
+    server = None
+    try:
+        router.register_kernel(KERNEL, k)
+        before = np.asarray(router.infer(KERNEL, probe))
+        server = make_server(router)
+        port = server.server_address[1]
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        load = _Load(port, rate=rate, ingest_frac=0.0, seed=seed)
+        time.sleep(1.5)           # baseline bins
+        t_kill = load.now()
+        router.kill_replica(0)
+        records = load.finish(settle_s=2.5)
+        after = np.asarray(router.infer(KERNEL, probe))
+        doc = router.health()
+        out.update(blast_radius(records, t_kill))
+        # the router is supposed to make the death invisible at the
+        # edge: once the kill has settled (in-flight victims re-routed
+        # or failed within a beat), NOTHING may be lost on survivors
+        out["survivors_lost"] = sum(
+            1 for r in records
+            if r["status"] == "lost" and r["t"] >= t_kill + 0.25)
+        out["live_replicas"] = doc["router"]["live_replicas"]
+        out["survivor_bitwise"] = bool(np.array_equal(before, after))
+        out["ok"] = bool(out["recovery_s"] is not None
+                         and out["survivors_lost"] == 0
+                         and out["live_replicas"] == n_replicas - 1
+                         and out["survivor_bitwise"])
+        return out
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        router.close()
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
     "sentinel": drill_sentinel,
+    "replica": drill_replica,
 }
 
 
@@ -561,15 +627,39 @@ def run_bench_drill(*, rate: float = 40.0) -> dict:
     return out
 
 
+def run_bench_replica_drill(*, rate: float = 80.0,
+                            n_replicas: int = 3) -> dict:
+    """The bench.py fold-in for the replica drill: kill 1 of N under
+    load and report the blast radius as gateable numbers
+    (``drill_replica_dip_pct`` / ``drill_replica_survivors_lost``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_replica(tmp, rate=rate, n_replicas=n_replicas)
+    out = {
+        "metric": "replica_drill",
+        "drill": row,
+        "goodput_dip_pct": row.get("goodput_dip_pct"),
+        "recovery_s": row.get("recovery_s"),
+        "lost": row.get("lost"),
+        "survivors_lost": row.get("survivors_lost"),
+        "survivor_bitwise": row.get("survivor_bitwise"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 # --------------------------------------------------------------- main
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
-                    "(kill9 / reload / sentinel)")
+                    "(kill9 / reload / sentinel / replica)")
     ap.add_argument("--drill", default="all",
-                    choices=("all", "kill9", "reload", "sentinel"))
+                    choices=("all", "kill9", "reload", "sentinel",
+                             "replica"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

@@ -58,10 +58,19 @@ rollback that doesn't say which version it restored makes an incident
 unreconstructable, so their shapes (and the promote/rollback version
 bookkeeping) are frozen the same way the ledger rows are.
 
+And the multi-replica routing schema lint
+(:func:`lint_serve_replicas`): the ``router.*`` / ``replica.*``
+records (hpnn_tpu/serve/router.py, docs/serving.md "Scale-out") are
+how an operator reconstructs a placement decision — a route count
+without a rank, a shed-around without a reason, or an outstanding
+gauge that can go negative makes a capacity incident unattributable,
+so their shapes are frozen too.
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--chaos PATH]
+        [--serve-replicas PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -679,7 +688,8 @@ def lint_online(path: str) -> list[str]:
 # hpnn_tpu/online/wal.py, tools/chaos_drill.py; docs/resilience.md)
 CHAOS_ACTIONS = ("kill", "raise", "delay", "nan")
 WAL_SKIP_REASONS = ("sig", "torn", "magic")
-DRILL_EVS = ("drill.kill9", "drill.reload", "drill.sentinel")
+DRILL_EVS = ("drill.kill9", "drill.reload", "drill.sentinel",
+             "drill.replica")
 
 
 def lint_chaos(path: str) -> list[str]:
@@ -848,11 +858,166 @@ def lint_chaos(path: str) -> list[str]:
                     failures.append(
                         f"{at}: passing drill.kill9 recovery_s "
                         f"{rs!r} is not a non-negative number")
+            if ev == "drill.replica" and ok:
+                # the route-around contract: a passing replica drill
+                # PROVED zero loss on survivors and bitwise answers
+                if rec.get("survivors_lost") != 0:
+                    failures.append(
+                        f"{at}: passing drill.replica with "
+                        f"survivors_lost "
+                        f"{rec.get('survivors_lost')!r} != 0")
+                if rec.get("survivor_bitwise") is not True:
+                    failures.append(
+                        f"{at}: passing drill.replica without "
+                        "survivor_bitwise=true — survivors were "
+                        "never proven bitwise")
+                rs = rec.get("recovery_s")
+                if not _num(rs) or not math.isfinite(rs) or rs < 0:
+                    failures.append(
+                        f"{at}: passing drill.replica recovery_s "
+                        f"{rs!r} is not a non-negative number")
     if not n_seen:
         failures.append(
             f"{path!r} has no chaos.* / wal.* / drill.* / "
             "drain records — was HPNN_CHAOS or HPNN_WAL_DIR set, or "
             "is this not a drill output?")
+    return failures
+
+
+# the multi-replica routing record contracts (serve/router.py,
+# serve/replica.py, serve/compile_cache.py; docs/serving.md
+# "Scale-out")
+ROUTER_COUNTS = ("router.route", "router.shed_around", "router.spill")
+WARM_COUNTS = ("serve.compile_warm_hit", "serve.compile_warm_miss")
+
+
+def lint_serve_replicas(path: str) -> list[str]:
+    """Schema-lint the multi-replica routing records of one metrics
+    sink (a run against a :class:`~hpnn_tpu.serve.router.Router`).
+
+    Checks, per record:
+
+    * ``router.route`` counts — ``kind == "count"``; ``rank`` a
+      non-negative int (the placement decision must be attributable
+      to a replica); non-empty ``kernel``; ``rows`` an int >= 1.
+    * ``router.shed_around`` counts — ``rank`` a non-negative int and
+      a non-empty ``reason`` (a route-around that can't say who
+      refused or why is undebuggable).
+    * ``router.spill`` counts — non-empty ``kernel``, ``rows`` an
+      int >= 1 (the TP spill must say how big the block was).
+    * ``router.fence`` events — non-empty ``op`` and ``kernel``;
+      ``replicas`` an int >= 1; ``to_version``, when not null, an
+      int >= 0 (versions start at 0 on first register; the version
+      edge is the old-or-new proof).
+    * ``router.replica_up`` / ``router.replica_down`` events —
+      ``rank`` a non-negative int.
+    * ``replica.outstanding`` gauges — ``rank`` a non-negative int,
+      finite ``value`` >= 0 (in-flight row depth can't go negative).
+    * ``serve.compile_warm_hit`` / ``_miss`` counts — ``kind ==
+      "count"``, positive ``n``.
+
+    A sink with no ``router.*`` / ``replica.*`` records fails — this
+    lint only makes sense on a run that actually routed through a
+    Router.  Returns failure strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+
+    def _rank_ok(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+    n_router = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if isinstance(ev, str) and (ev.startswith("router.")
+                                    or ev.startswith("replica.")):
+            n_router += 1
+        if ev in ROUTER_COUNTS:
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'count'")
+            if ev in ("router.route", "router.shed_around") \
+                    and not _rank_ok(rec.get("rank")):
+                failures.append(
+                    f"{at}: {ev} rank {rec.get('rank')!r} is not a "
+                    "non-negative int")
+            if ev in ("router.route", "router.spill"):
+                k = rec.get("kernel")
+                if not isinstance(k, str) or not k:
+                    failures.append(
+                        f"{at}: {ev} kernel {k!r} is not a non-empty "
+                        "string")
+                if not _pos_int(rec.get("rows")):
+                    failures.append(
+                        f"{at}: {ev} rows {rec.get('rows')!r} is not "
+                        "an int >= 1")
+            if ev == "router.shed_around":
+                r = rec.get("reason")
+                if not isinstance(r, str) or not r:
+                    failures.append(
+                        f"{at}: router.shed_around reason {r!r} is "
+                        "not a non-empty string")
+        elif ev == "router.fence":
+            for key in ("op", "kernel"):
+                v = rec.get(key)
+                if not isinstance(v, str) or not v:
+                    failures.append(
+                        f"{at}: router.fence {key} {v!r} is not a "
+                        "non-empty string")
+            if not _pos_int(rec.get("replicas")):
+                failures.append(
+                    f"{at}: router.fence replicas "
+                    f"{rec.get('replicas')!r} is not an int >= 1")
+            tv = rec.get("to_version")
+            if tv is not None and (not isinstance(tv, int)
+                                   or isinstance(tv, bool) or tv < 0):
+                failures.append(
+                    f"{at}: router.fence to_version {tv!r} is not "
+                    "null or an int >= 0")
+        elif ev in ("router.replica_up", "router.replica_down"):
+            if not _rank_ok(rec.get("rank")):
+                failures.append(
+                    f"{at}: {ev} rank {rec.get('rank')!r} is not a "
+                    "non-negative int")
+        elif ev == "replica.outstanding":
+            if rec.get("kind") != "gauge":
+                failures.append(
+                    f"{at}: replica.outstanding kind "
+                    f"{rec.get('kind')!r} != 'gauge'")
+            if not _rank_ok(rec.get("rank")):
+                failures.append(
+                    f"{at}: replica.outstanding rank "
+                    f"{rec.get('rank')!r} is not a non-negative int")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: replica.outstanding value {v!r} is not a "
+                    "finite non-negative number")
+        elif ev in WARM_COUNTS:
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'count'")
+            if not _pos_int(rec.get("n")):
+                failures.append(
+                    f"{at}: {ev} increment {rec.get('n')!r} is not a "
+                    "positive int")
+    if not n_router:
+        failures.append(
+            f"sink {path!r} has no router.* / replica.* records — "
+            "did this run route through a Router?")
     return failures
 
 
@@ -892,6 +1057,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_chaos(argv[i + 1])
+    if "--serve-replicas" in argv:
+        i = argv.index("--serve-replicas")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --serve-replicas "
+                             "needs a path\n")
+            return 2
+        failures += lint_serve_replicas(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -22,7 +22,9 @@ probes + sentinel + checksum ledger (``HPNN_PROBES`` /
 ``HPNN_NUMERICS`` / ``HPNN_LEDGER``), lifecycle spans + compiled-cost
 attribution (``HPNN_SPANS`` / ``HPNN_COST``), the SLO tracker
 (``HPNN_SLO_MS`` — load shedding is additionally exercised to an
-actual Shed rejection in the serve section below), the whole
+actual Shed rejection in the serve section below, and the serve
+section also routes a 2-replica Router round trip with the
+persistent compile cache armed, ``HPNN_COMPILE_CACHE_DIR``), the whole
 ``HPNN_ONLINE_*`` train-while-serve knob family (inert outside
 ``hpnn_tpu/online/``; a full feed → train → gate → rollback round is
 additionally exercised to silence below), the chaos + durability
@@ -354,12 +356,44 @@ def check(tmpdir: str) -> list[str]:
             "online sink carries neither online.promote nor "
             "online.reject — the gate never ruled")
 
+    # Multi-replica scale-out (serve/router.py, docs/serving.md
+    # "Scale-out") rides the same silence contract: a 2-replica Router
+    # in compiled mode with the persistent compile cache ARMED
+    # (HPNN_COMPILE_CACHE_DIR — the warm-boot path writes executables
+    # to disk and counts hits/misses), fan-out register, routed
+    # infers (single vector + row block), a fenced install_kernel
+    # promotion — not one stdout byte from any of it.
+    from hpnn_tpu.serve import compile_cache as cc_mod
+
+    cache_dir = os.path.join(tmpdir, "xla_cache")
+    os.environ[cc_mod.ENV_DIR] = cache_dir
+    router_buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(router_buf):
+            router = serve.Router(2, max_batch=8, n_buckets=1,
+                                  max_wait_ms=1.0, mode="compiled")
+            router.register_kernel("lint_router", k)
+            router.infer("lint_router", np.zeros(8))
+            router.infer("lint_router", np.zeros((3, 8)))
+            k3, _ = kernel_mod.generate(13, 8, [5], 2)
+            router.install_kernel("lint_router", k3)
+            router.infer("lint_router", np.zeros(8))
+            router.close()
+    finally:
+        os.environ.pop(cc_mod.ENV_DIR, None)
+        cc_mod._reset_for_tests()
+    if router_buf.getvalue():
+        failures.append(
+            "2-replica Router round trip wrote stdout: "
+            f"{router_buf.getvalue()[:120]!r}")
+
     with_serve = _run_round(os.path.join(tmpdir, "c"), None)
     if plain != with_serve:
         failures.append(
             "stdout is NOT byte-identical after importing/exercising "
-            "hpnn_tpu.serve (per-kernel + fleet), train.fleet, and "
-            f"hpnn_tpu.online (plain {len(plain)}B vs "
+            "hpnn_tpu.serve (per-kernel + fleet + 2-replica Router "
+            "with the persistent compile cache armed), train.fleet, "
+            f"and hpnn_tpu.online (plain {len(plain)}B vs "
             f"with-serve {len(with_serve)}B)")
 
     # The zero-perturbation proof for the numerics probes: a run with
