@@ -20,7 +20,13 @@ member on its own RNG stream over the shared window.
 Knobs (read once, at construction; args override):
 ``HPNN_ONLINE_ROWS`` (window, default 64), ``HPNN_ONLINE_BATCH``
 (default 8, must divide rows), ``HPNN_ONLINE_EPOCHS`` (default 4),
-``HPNN_ONLINE_INTERVAL_S`` (background cadence, default 1.0).
+``HPNN_ONLINE_INTERVAL_S`` (background cadence, default 1.0),
+``HPNN_ONLINE_SCAN_K`` (default 1: rounds per dispatch — K>1 scans K
+training rounds inside ONE ``jit(vmap(scan))`` executable via
+``fleet.make_fleet_multi_round_fn``, amortizing the ~20 us dispatch
+tax Kx; all K rounds train on one window snapshot, and the round
+counter advances by K so per-round RNG streams match K unscanned
+rounds — see docs/performance.md).
 
 Observability: ``online.round`` events, ``online.train_round`` spans,
 ``online.train_loss`` / ``online.staleness_s`` gauges,
@@ -50,6 +56,7 @@ class OnlineTrainer:
                  rows: int | None = None, batch: int | None = None,
                  epochs: int | None = None,
                  interval_s: float | None = None,
+                 scan_k: int | None = None,
                  momentum: bool = False, replay_frac: float = 0.25,
                  seed: int = 0, clock=time.monotonic):
         self.buffer = buffer
@@ -64,9 +71,13 @@ class OnlineTrainer:
         self.interval_s = float(
             interval_s if interval_s is not None
             else _env_float("HPNN_ONLINE_INTERVAL_S", 1.0))
+        self.scan_k = int(scan_k if scan_k is not None
+                          else _env_int("HPNN_ONLINE_SCAN_K", 1))
         if self.rows % self.batch:
             raise ValueError(
                 f"batch {self.batch} must divide rows {self.rows}")
+        if self.scan_k < 1:
+            raise ValueError(f"scan_k must be >= 1, got {self.scan_k}")
         self.momentum = bool(momentum)
         self.replay_frac = float(replay_frac)
         self.eval_set = None        # overrides the buffer's holdout
@@ -104,8 +115,9 @@ class OnlineTrainer:
         key = (kind, n_steps, model, self.momentum, members)
         fn = self._fns.get(key)
         if fn is None:
-            maker = (fleet.make_fleet_epoch_fn if kind == "fleet"
-                     else fleet.make_member_epoch_fn)
+            maker = {"fleet": fleet.make_fleet_epoch_fn,
+                     "multi": fleet.make_fleet_multi_round_fn,
+                     "member": fleet.make_member_epoch_fn}[kind]
             fn = maker(n_steps, model=model, momentum=self.momentum,
                        count=False)
             self._fns[key] = fn
@@ -131,6 +143,32 @@ class OnlineTrainer:
         model = entries[0].model
         seeds = [self._seed + 7919 * self._round + i
                  for i in range(len(entries))]
+        if self.scan_k > 1:
+            # K rounds per dispatch: one jit(vmap(scan)) executable.
+            # Round r draws the seeds an unscanned round self._round+r
+            # would, so the RNG trajectory matches K plain rounds
+            # (trained on this round's window snapshot).
+            n = len(entries)
+            seed_rounds = [
+                [self._seed + 7919 * (self._round + r) + i
+                 for i in range(n)] for r in range(self.scan_k)]
+            stacked = fleet.stack_kernels([e.kernel for e in entries])
+            perms, orders = fleet.multi_round_plan(
+                seed_rounds, n_rows=self.rows, batch=self.batch,
+                epochs=self.epochs)
+            fn = self._fn("multi", n_steps, model, n)
+            with obs.spans.span("train.multi_round", members=n,
+                                k=self.scan_k, mode="online"):
+                w2, _dw, losses, _ = fn(stacked,
+                                        self._zeros_dw(stacked),
+                                        X, T, perms, orders)
+            members = fleet.unstack_kernels(w2)
+            losses = np.asarray(losses)     # (N, K, epochs, steps)
+            return {
+                e.name: (members[i].weights,
+                         float(losses[i, -1, -1].mean()))
+                for i, e in enumerate(entries)
+            }
         if len(entries) >= 2:
             stacked = fleet.stack_kernels([e.kernel for e in entries])
             perms, orders = fleet.fleet_plan(
@@ -223,7 +261,9 @@ class OnlineTrainer:
                   train_s=round(train_s, 6))
         self.stats["rounds"] += 1
         self.stats["trained"] += len(candidates)
-        self._round += 1
+        # scan_k rounds were consumed in one dispatch: advance the
+        # counter by K so the next round's seeds don't replay streams
+        self._round += self.scan_k
         return summary
 
     # ------------------------------------------------------- thread loop

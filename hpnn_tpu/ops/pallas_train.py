@@ -895,6 +895,166 @@ def train_epoch_dbuf_banked(
     return new_w, new_dw, results[n_state]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("batch", "model", "momentum", "lr", "alpha",
+                              "interpret")
+)
+def train_fleet_epoch_dbuf_banked(
+    weights,
+    dw,
+    X_banks,
+    T_banks,
+    orders,
+    *,
+    batch: int,
+    model: str = "ann",
+    momentum: bool = False,
+    lr: float | None = None,
+    alpha: float = 0.2,
+    interpret: bool = False,
+):
+    """The double-buffered banked epoch for a STACKED FLEET: N
+    same-topology members' whole epochs in ONE Mosaic launch.
+
+    :func:`train_epoch_dbuf_banked` owns the HBM→VMEM pipeline for
+    one kernel; the fleet path (train/fleet.py) so far only had the
+    vmapped pure-jnp epoch, which leaves the block fetches to XLA.
+    This kernel extends the explicit 2-slot DMA rotation to the
+    fleet-stacked bank layout: ``grid=(N,)`` over members, member
+    ``i``'s weights DMA'd in as a ``(1, ...)`` block (VMEM-resident
+    for its whole epoch, aliased in place), its pre-permuted bank
+    rows streamed from the HBM-resident ``X_banks[i]``/``T_banks[i]``
+    with the same start-next/wait-own semaphore rotation, and its
+    per-step losses written to row ``i`` of the ``(N, S)`` loss
+    output.  Semantics are exactly N successive
+    :func:`train_epoch_dbuf_banked` epochs (member ``i`` on bank
+    ``i``, block order ``orders[i]``) — parity-tested bitwise in
+    interpret mode by tests/test_quant.py.
+
+    X_banks: (N, S·B, n_in); T_banks: (N, S·B, n_out) — each member's
+    bank already carries that member's epoch permutation (the
+    ``bank[perm]`` device-side permute of the scan-ordered bank
+    layout).  orders: (N, S) int32 per-member block ids.  Returns
+    (stacked_weights, stacked_dw, losses[N, S]).
+    """
+    n_layers = len(weights)
+    if lr is None:
+        from hpnn_tpu.parallel import dp
+
+        lr = dp.default_lr(model, momentum)
+    weights = tuple(jnp.asarray(wl, dtype=_F32) for wl in weights)
+    dw = tuple(jnp.asarray(m, dtype=_F32) for m in dw) if momentum else ()
+    X_banks = jnp.asarray(X_banks, dtype=_F32)
+    T_banks = jnp.asarray(T_banks, dtype=_F32)
+    B = int(batch)
+    N = int(orders.shape[0])
+    S = int(orders.shape[1])
+    n_in = X_banks.shape[2]
+    n_out = T_banks.shape[2]
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    hbm = pl.BlockSpec(memory_space=pltpu.ANY)
+    n_state = n_layers * (2 if momentum else 1)
+    state = tuple(weights) + tuple(dw)
+
+    def _member_spec(arr):
+        # one member's block of the stacked state: (1, ...) at row i
+        nd = len(arr.shape)
+        return pl.BlockSpec((1,) + tuple(arr.shape[1:]),
+                            lambda i, _n=nd: (i,) + (0,) * (_n - 1))
+
+    out_shape = (
+        tuple(jax.ShapeDtypeStruct(wl.shape, _F32) for wl in weights)
+        + (tuple(jax.ShapeDtypeStruct(m.shape, _F32) for m in dw)
+           if momentum else ())
+        + (jax.ShapeDtypeStruct((N, S), _F32),)  # per-member losses
+    )
+    # inputs: (orders, X_banks, T_banks, state...) — state starts at 3
+    aliases = {3 + i: i for i in range(n_state)}
+
+    def kernel(ord_ref, x_hbm, t_hbm, *refs):
+        i = pl.program_id(0)
+        out_state = refs[n_state : 2 * n_state]
+        w = [r.at[0] for r in out_state[:n_layers]]
+        dwr = ([r.at[0] for r in out_state[n_layers:]]
+               if momentum else [])
+        loss_row = refs[2 * n_state].at[i]
+        acts = list(refs[2 * n_state + 1 : 2 * n_state + 1 + n_layers])
+        ds = list(refs[2 * n_state + 1 + n_layers
+                       : 2 * n_state + 1 + 2 * n_layers])
+
+        def scoped(xbuf, tbuf, sem_x, sem_t):
+            def copies(slot, step):
+                blk = ord_ref[i, step]
+                return (
+                    pltpu.make_async_copy(
+                        x_hbm.at[i, pl.ds(blk * B, B)], xbuf.at[slot],
+                        sem_x.at[slot]),
+                    pltpu.make_async_copy(
+                        t_hbm.at[i, pl.ds(blk * B, B)], tbuf.at[slot],
+                        sem_t.at[slot]),
+                )
+
+            # warm-up: this member's block orders[i, 0] into slot 0
+            for c in copies(0, 0):
+                c.start()
+
+            def body(step, carry):
+                cur = lax.rem(step, 2)
+                nxt = lax.rem(step + 1, 2)
+
+                @pl.when(step + 1 < S)
+                def _():
+                    for c in copies(nxt, step + 1):
+                        c.start()
+
+                for c in copies(cur, step):
+                    c.wait()
+                _batch_step_math(
+                    xbuf[cur],
+                    tbuf[cur],
+                    w,
+                    dwr,
+                    acts,
+                    ds,
+                    loss_row,
+                    step,
+                    n_layers=n_layers,
+                    model=model,
+                    momentum=momentum,
+                    lr=float(lr),
+                    alpha=float(alpha),
+                    inv_b=1.0 / B,
+                )
+                return carry
+
+            lax.fori_loop(0, S, body, 0)
+
+        pl.run_scoped(
+            scoped,
+            xbuf=pltpu.VMEM((2, B, n_in), _F32),
+            tbuf=pltpu.VMEM((2, B, n_out), _F32),
+            sem_x=pltpu.SemaphoreType.DMA((2,)),
+            sem_t=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    results = pl.pallas_call(
+        kernel,
+        grid=(N,),
+        out_shape=out_shape,
+        in_specs=[smem, hbm, hbm] + [_member_spec(s) for s in state],
+        out_specs=tuple(_member_spec(s) for s in state) + (smem,),
+        scratch_shapes=[
+            pltpu.VMEM((B, wl.shape[1]), _F32) for wl in weights
+        ] + [pltpu.VMEM((B, wl.shape[1]), _F32) for wl in weights],
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(jnp.asarray(orders, dtype=jnp.int32), X_banks, T_banks, *state)
+    new_w = tuple(results[:n_layers])
+    new_dw = tuple(results[n_layers : 2 * n_layers]) if momentum else ()
+    return new_w, new_dw, results[n_state]
+
+
 def make_pallas_epoch_fn(weights, *, model: str = "ann",
                          momentum: bool = False,
                          lr: float | None = None, alpha: float = 0.2,

@@ -42,6 +42,22 @@ obs counters, so the steady-state no-compiles-after-warmup invariant
 is asserted identically.  jax is imported lazily inside the class so
 ``import hpnn_tpu.serve`` stays jax-free (same discipline as
 ``hpnn_tpu/obs``).
+
+**Low-precision serving** (compiled mode only): a per-kernel precision
+policy — ``Entry.precision`` (``registry.set_precision``) overriding
+the process default ``HPNN_SERVE_DTYPE`` — compiles the bucket
+executables in ``bf16``/``f32``/``f64``, or with int8 weights and
+bf16 activations (``"int8"``).  Weights are cast (or symmetrically
+quantized, :func:`quantize_weights`) ONCE per (kernel, version,
+policy) and cached; the executable's host IO stays the kernel's
+native dtype (inputs cast down and outputs cast back inside the jit,
+so the Batcher/Router/Replica plumbing is unchanged) and every matmul
+keeps the f32-accumulation pin.  ``warmup`` measures each quantized
+kernel's error against the eager f64 reference on a probe block —
+the ``numerics.quant_err`` gauge and the ``/healthz`` ``precision``
+section — so the error bound is continuously *measured*, never
+assumed (docs/performance.md).  Parity mode ignores the policy: its
+contract is bitwise equality with the embedded caller.
 """
 
 from __future__ import annotations
@@ -56,11 +72,33 @@ import numpy as np
 
 from hpnn_tpu import chaos, obs
 from hpnn_tpu.serve import compile_cache
-from hpnn_tpu.serve.registry import Entry, Registry
+from hpnn_tpu.serve.registry import PRECISIONS, Entry, Registry
 
 DEFAULT_MAX_BATCH = 64
 DEFAULT_N_BUCKETS = 4
 _MODES = ("parity", "compiled")
+
+
+def quantize_weights(weights, *, bits: int = 8):
+    """Symmetric per-tensor weight quantization: each layer matrix is
+    mapped to ``round(w / scale)`` int8 (or narrower) with ``scale =
+    absmax / (2^(bits-1) - 1)``.  Returns ``(quants, scales)`` —
+    int8 numpy arrays and their per-layer float scales.  The serve
+    path dequantizes inside the executable (``q * scale`` in bf16),
+    so HBM holds 1 byte/weight; ``bits`` narrows the grid for the
+    monotone-error test (fewer bits can only hurt)."""
+    if not 2 <= bits <= 8:
+        raise ValueError(f"bits must be in [2, 8], got {bits}")
+    qmax = float(2 ** (bits - 1) - 1)
+    quants, scales = [], []
+    for w in weights:
+        w = np.asarray(w, dtype=np.float64)
+        absmax = float(np.max(np.abs(w)))
+        scale = (absmax / qmax) if absmax > 0 else 1.0
+        q = np.clip(np.rint(w / scale), -qmax, qmax).astype(np.int8)
+        quants.append(q)
+        scales.append(scale)
+    return quants, scales
 
 
 def fleet_key(entry: Entry) -> tuple:
@@ -106,9 +144,10 @@ class Engine:
     """Pads batches into buckets and runs the compiled forwards.
 
     One engine serves every kernel in ``registry``; executables are
-    cached per ``(name, version, bucket, dtype)`` so a registry
-    hot-reload (version bump) transparently compiles fresh code while
-    the old version's executables age out untouched.
+    cached per ``(name, version, bucket, dtype, precision)`` so a
+    registry hot-reload (version bump) or a precision retag
+    transparently compiles fresh code while the old version's
+    executables age out untouched.
     """
 
     def __init__(self, registry: Registry, *,
@@ -125,6 +164,17 @@ class Engine:
         self.max_batch = int(max_batch)
         self.buckets = bucket_menu(max_batch, n_buckets)
         self._mode = mode          # resolved lazily: needs the backend
+        # process-default serve precision (read once; per-entry
+        # Entry.precision overrides).  None = native full precision.
+        default_prec = os.environ.get("HPNN_SERVE_DTYPE") or None
+        if default_prec is not None and default_prec not in PRECISIONS:
+            raise ValueError(
+                f"HPNN_SERVE_DTYPE={default_prec!r} not in "
+                f"{'|'.join(PRECISIONS)}")
+        self.default_precision = default_prec
+        # kernel -> measured max |lowp - f64| on the warmup probe
+        # block (the /healthz precision section's error bound)
+        self._quant_err: dict[str, float] = {}
         # replica pinning (serve/replica.py): weights + executables for
         # this engine live on jax.local_devices()[device_index % n] —
         # N engines spread the registry across N chips.  None (the
@@ -134,8 +184,8 @@ class Engine:
         self._lock = threading.Lock()
         self._compiled: dict[tuple, object] = {}
         self._weights_cache: dict[tuple, tuple] = {}
-        # (name, version, bucket, dtype) -> hits / misses / compile_s:
-        # the cold-start cost surface exposed on /healthz
+        # (name, version, bucket, dtype, precision) -> hits / misses /
+        # compile_s: the cold-start cost surface exposed on /healthz
         self._cache_stats: dict[tuple, dict] = {}
 
     @property
@@ -159,40 +209,75 @@ class Engine:
         local = jax.local_devices()
         return local[self.device_index % len(local)]
 
-    def _device_weights(self, entry: Entry):
-        """Entry weights as device arrays, cached per (name, version);
-        placed on the pinned replica device when one is set."""
+    def _precision(self, entry: Entry) -> str | None:
+        """The entry's resolved serve compute policy: per-entry
+        override, else the process default, else None (native)."""
+        prec = getattr(entry, "precision", None)
+        return prec if prec is not None else self.default_precision
+
+    @staticmethod
+    def _compute_dtype(prec: str):
+        import jax.numpy as jnp
+
+        # "int8" = int8 weights dequantized to bf16 activations
+        return {"bf16": jnp.bfloat16, "int8": jnp.bfloat16,
+                "f32": jnp.float32, "f64": jnp.float64}[prec]
+
+    def _device_weights(self, entry: Entry, prec: str | None = None):
+        """Entry weights as device arrays, cached per (name, version,
+        policy); placed on the pinned replica device when one is set.
+        This is the cast-ONCE point of the precision policy: bf16/f32
+        /f64 weights are cast here, int8 weights arrive as
+        ``(quantized int8 arrays, per-layer scales)``."""
         import jax
         import jax.numpy as jnp
 
-        key = (entry.name, entry.version)
+        key = (entry.name, entry.version, prec)
         with self._lock:
             w = self._weights_cache.get(key)
         if w is None:
             dev = self._device()
-            if dev is not None:
-                w = tuple(jax.device_put(np.asarray(a), dev) for a in
-                          entry.kernel.weights)
+            if prec == "int8":
+                quants, scales = quantize_weights(entry.kernel.weights)
+                if dev is not None:
+                    qs = tuple(jax.device_put(q, dev) for q in quants)
+                else:
+                    qs = tuple(jnp.asarray(q) for q in quants)
+                w = (qs, tuple(scales))
             else:
-                w = tuple(jnp.asarray(np.asarray(a)) for a in
-                          entry.kernel.weights)
+                mats = [np.asarray(a) for a in entry.kernel.weights]
+                if dev is not None:
+                    w = tuple(jax.device_put(a, dev) for a in mats)
+                else:
+                    w = tuple(jnp.asarray(a) for a in mats)
+                if prec is not None:
+                    cdt = self._compute_dtype(prec)
+                    w = tuple(a.astype(cdt) for a in w)
             with self._lock:
                 self._weights_cache[key] = w
         return w
 
-    def _compiled_forward(self, entry: Entry, bucket: int, dtype):
+    def _compiled_forward(self, entry: Entry, bucket: int, dtype,
+                          prec: str | None = None):
         """The cached ``(R ≤ bucket, n_in) -> (R, n_out)`` forward for
         ``entry``.  Fills (and counts) the cache at most once per
-        (name, version, bucket, dtype).
+        (name, version, bucket, dtype, precision).
 
         compiled mode: an AOT executable over the padded
-        ``(bucket, n_in)`` block.  parity mode: a host closure running
+        ``(bucket, n_in)`` block — under a precision policy the
+        compute runs in the policy dtype (int8 weights dequantize to
+        bf16 in-program) while the host-facing IO keeps ``dtype``, so
+        callers are unchanged.  parity mode: a host closure running
         each row through the eager per-sample ``model.run`` — exactly
-        the ``run_nn`` numerics (module docstring)."""
+        the ``run_nn`` numerics (module docstring; the policy is
+        ignored, parity means bitwise)."""
         import jax
 
         dtype = np.dtype(dtype)
-        key = (entry.name, entry.version, bucket, dtype.str)
+        if self.mode == "parity":
+            prec = None
+        key = (entry.name, entry.version, bucket, dtype.str,
+               prec or "native")
         with self._lock:
             fn = self._compiled.get(key)
             if fn is not None:
@@ -220,9 +305,29 @@ class Engine:
             # warm HPNN_COMPILE_CACHE_DIR turns this compile into a
             # disk read (serve/compile_cache.py; no-op when unset)
             compile_cache.arm()
-            weights = self._device_weights(entry)
-            def batch_forward(xs):
-                return jax.vmap(lambda x: model.run(weights, x))(xs)
+            weights = self._device_weights(entry, prec)
+            if prec == "int8":
+                qs, scales = weights
+                cdt = self._compute_dtype(prec)
+
+                def batch_forward(xs):
+                    # dequantize in-program: HBM holds 1 byte/weight,
+                    # the VPU pays one cheap scale per layer
+                    w = tuple(q.astype(cdt) * s
+                              for q, s in zip(qs, scales))
+                    out = jax.vmap(
+                        lambda x: model.run(w, x))(xs.astype(cdt))
+                    return out.astype(xs.dtype)
+            elif prec is not None:
+                cdt = self._compute_dtype(prec)
+
+                def batch_forward(xs):
+                    out = jax.vmap(
+                        lambda x: model.run(weights, x))(xs.astype(cdt))
+                    return out.astype(xs.dtype)
+            else:
+                def batch_forward(xs):
+                    return jax.vmap(lambda x: model.run(weights, x))(xs)
 
             # CPU XLA does not implement buffer donation (it would
             # emit a warning per dispatch); everywhere else the padded
@@ -233,7 +338,8 @@ class Engine:
             dev = self._device()
             with obs.timer("serve.compile_time", kernel=entry.name,
                            bucket=bucket):
-                # the same HIGHEST matmul pin as batch.make_eval_fn;
+                # the same HIGHEST matmul pin as batch.make_eval_fn —
+                # for bf16 operands this is the f32-accumulation pin;
                 # a pinned replica compiles for its own device
                 with jax.default_matmul_precision("float32"), \
                         (jax.default_device(dev) if dev is not None
@@ -250,7 +356,7 @@ class Engine:
                 version=entry.version, bucket=bucket, mode=self.mode)
         obs.count("serve.compile", kernel=entry.name,
                   version=entry.version, bucket=bucket, dtype=dtype.str,
-                  mode=self.mode)
+                  precision=prec or "native", mode=self.mode)
         with self._lock:
             # a racing fill of the same key is harmless (identical
             # executable); last writer wins
@@ -262,8 +368,9 @@ class Engine:
 
     @staticmethod
     def _exe_name(key: tuple) -> str:
-        name, version, bucket, _dtype = key
-        return f"serve.{name}.v{version}.b{bucket}"
+        name, version, bucket, _dtype, prec = key
+        base = f"serve.{name}.v{version}.b{bucket}"
+        return base if prec == "native" else f"{base}.{prec}"
 
     def _stat(self, key: tuple) -> dict:
         # callers hold self._lock
@@ -278,27 +385,91 @@ class Engine:
         ``/healthz``: hits, misses, cumulative compile seconds.  After
         warmup every entry should show ``misses == 1`` and a growing
         hit count — a second miss is a cold-start regression."""
+        def label(k):
+            if len(k) == 5 and k[4] != "native":
+                return f"{k[0]}/v{k[1]}/b{k[2]}/{k[4]}"
+            return f"{k[0]}/v{k[1]}/b{k[2]}"
+
         with self._lock:
             return {
-                f"{k[0]}/v{k[1]}/b{k[2]}": {
+                label(k): {
                     "hits": s["hits"], "misses": s["misses"],
                     "compile_s": round(s["compile_s"], 6)}
-                for k, s in sorted(self._cache_stats.items())}
+                for k, s in sorted(self._cache_stats.items(),
+                                   key=lambda kv: str(kv[0]))}
+
+    def _probe_quant_err(self, entry: Entry, fn, bucket: int,
+                         dtype, prec: str) -> float:
+        """Measure the policy's error on a deterministic probe block:
+        ``max |policy output − eager f64 reference|``.  Published as
+        the ``numerics.quant_err`` gauge and the /healthz precision
+        section — the continuously measured bound docs/performance.md
+        documents per policy."""
+        if entry.model == "snn":
+            from hpnn_tpu.models import snn as model
+        else:
+            from hpnn_tpu.models import ann as model
+
+        rng = np.random.RandomState(0xC0FFEE)
+        xs = rng.randn(bucket, entry.n_inputs).astype(dtype)
+        low = np.asarray(fn(xs), dtype=np.float64)
+        w64 = [np.asarray(w, dtype=np.float64)
+               for w in entry.kernel.weights]
+        ref = np.stack([np.asarray(model.run(w64, x))
+                        for x in xs.astype(np.float64)])
+        err = float(np.max(np.abs(low - ref)))
+        self._quant_err[entry.name] = err
+        obs.gauge("numerics.quant_err", err, where="serve",
+                  kernel=entry.name, precision=prec, bucket=bucket)
+        return err
+
+    def precision_doc(self) -> dict:
+        """The /healthz ``precision`` section: the process default,
+        engine mode, and per-kernel resolved policy + measured
+        ``quant_err`` (present once warmup probed the kernel)."""
+        kernels = {}
+        for name in self.registry.names():
+            entry = self.registry.get(name)
+            prec = self._precision(entry)
+            doc = {"precision": prec or "native",
+                   "version": entry.version}
+            err = self._quant_err.get(name)
+            if err is not None:
+                doc["quant_err"] = err
+            kernels[name] = doc
+        return {"default": self.default_precision or "native",
+                "mode": self.mode, "kernels": kernels}
 
     def warmup(self, names=None, *, dtype=None) -> int:
         """Compile the full bucket menu for ``names`` (default: every
         registered kernel).  Returns the number of executables now
         resident.  Steady-state serving after warmup never compiles —
         the obs ``serve.compile`` total stays at
-        ``len(names) * len(self.buckets)``."""
+        ``len(names) * len(self.buckets)``.
+
+        Honors each entry's resolved precision policy, so warm
+        replica boot through the persistent compile cache
+        (``HPNN_COMPILE_CACHE_DIR``) persists the SAME low-precision
+        executables steady-state dispatch uses; quantized kernels get
+        a ``serve.precision`` event and a measured
+        ``numerics.quant_err`` probe (compiled mode)."""
         names = self.registry.names() if names is None else list(names)
         n = 0
         for name in names:
             entry = self.registry.get(name)
             dt = dtype or np.asarray(entry.kernel.weights[0]).dtype
+            prec = self._precision(entry)
             for bucket in self.buckets:
-                self._compiled_forward(entry, bucket, dt)
+                fn = self._compiled_forward(entry, bucket, dt,
+                                            prec=prec)
                 n += 1
+            if prec is not None and self.mode == "compiled":
+                obs.event("serve.precision", kernel=name,
+                          precision=prec, version=entry.version,
+                          source="warmup")
+                # fn is the top-bucket executable from the loop above
+                self._probe_quant_err(entry, fn, self.buckets[-1],
+                                      dt, prec)
         obs.event("serve.warmup", kernels=len(names),
                   buckets=len(self.buckets))
         # warm-start hit rate across the menu just compiled: 1.0 means
@@ -322,7 +493,15 @@ class Engine:
         if rows.ndim != 2 or rows.shape[1] != entry.n_inputs:
             raise ValueError(
                 f"rows must be (R, {entry.n_inputs}); got {rows.shape}")
+        # hoisted out of the chunk loop: the dtype, resolved precision
+        # policy, and per-bucket executables/identities are invariant
+        # across an over-menu block's chunks — re-deriving them per
+        # chunk cost a np.dtype + cache-key build on the hot path
         dtype = np.asarray(entry.kernel.weights[0]).dtype
+        prec = self._precision(entry)
+        prec_tag = (prec or "native") if self.mode != "parity" \
+            else "native"
+        fns: dict[int, tuple] = {}
         rows = rows.astype(dtype, copy=False)
         out = np.empty((rows.shape[0], entry.n_outputs), dtype=dtype)
         top = self.buckets[-1]
@@ -339,7 +518,14 @@ class Engine:
                       0.0 if self.mode == "parity"
                       else (bucket - n) / bucket,
                       kernel=entry.name, bucket=bucket, rows=n)
-            fn = self._compiled_forward(entry, bucket, dtype)
+            cached = fns.get(bucket)
+            if cached is None:
+                cached = fns[bucket] = (
+                    self._compiled_forward(entry, bucket, dtype,
+                                           prec=prec),
+                    self._exe_name((entry.name, entry.version, bucket,
+                                    dtype.str, prec_tag)))
+            fn, exe_name = cached
             if self.mode == "compiled" and n < bucket:
                 block = np.zeros((bucket, entry.n_inputs), dtype=dtype)
                 block[:n] = rows[start:start + n]
@@ -351,9 +537,7 @@ class Engine:
                 # padding does the full bucket's work, so the cataloged
                 # (per-bucket) cost applies unscaled
                 obs.cost.record_dispatch(
-                    self._exe_name((entry.name, entry.version, bucket,
-                                    dtype.str)),
-                    time.perf_counter() - t0)
+                    exe_name, time.perf_counter() - t0)
             else:
                 res = np.asarray(fn(block))
             out[start:start + n] = res[:n]

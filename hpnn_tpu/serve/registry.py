@@ -29,6 +29,11 @@ class RegistryError(ValueError):
     pass
 
 
+# Serve compute policies an Entry (or HPNN_SERVE_DTYPE) may name:
+# bf16/f32/f64 compute dtypes, or int8 weights with bf16 activations.
+PRECISIONS = ("bf16", "f32", "f64", "int8")
+
+
 class Entry(NamedTuple):
     """One resident kernel: an immutable snapshot of (weights, type).
 
@@ -39,6 +44,12 @@ class Entry(NamedTuple):
     st_size)``: float mtime alone cannot see a same-second rewrite on
     coarse-timestamp filesystems, a race the online trainer's rapid
     promote cadence makes realistic (docs/online.md).
+    ``precision`` is the per-entry serve compute policy
+    (``bf16|f32|f64|int8``, or None = the process default from
+    ``HPNN_SERVE_DTYPE``, or full native precision when that is unset
+    too) — the engine compiles this entry's forwards in that dtype
+    (docs/performance.md); it survives reloads/installs like
+    ``path``/``sig`` do.
     """
 
     name: str
@@ -48,6 +59,7 @@ class Entry(NamedTuple):
     path: str | None
     mtime: float | None
     sig: tuple | None = None
+    precision: str | None = None
 
     @property
     def n_inputs(self) -> int:
@@ -84,6 +96,7 @@ class Registry:
         self, name: str, kernel: kernel_mod.Kernel, *, model: str = "ann",
         path: str | None = None, mtime: float | None = None,
         sig: tuple | None = None, version: int | None = None,
+        precision: str | None = None,
     ) -> Entry:
         """Install (or replace) ``name`` with in-memory weights.
 
@@ -93,14 +106,22 @@ class Registry:
         (``serve.<kernel>.v<V>.b<B>``) line up across the fleet
         (serve/router.py)."""
         _check_model(model)
+        if precision is not None and precision not in PRECISIONS:
+            raise RegistryError(
+                f"unknown precision {precision!r} "
+                f"(want {'|'.join(PRECISIONS)})")
         if not kernel_mod.validate(kernel):
             raise RegistryError(f"kernel {name!r} failed validation")
         with self._lock:
             prev = self._entries.get(name)
             if version is None:
                 version = prev.version + 1 if prev is not None else 0
+            if precision is None and prev is not None:
+                # the policy sticks across reloads/installs, like
+                # path/sig: a hot-reload must not silently dequantize
+                precision = prev.precision
             entry = Entry(name, kernel, model, int(version), path,
-                          mtime, sig)
+                          mtime, sig, precision)
             self._entries[name] = entry
         obs.count("serve.kernel_load", kernel=name, version=version,
                   source="file" if path else "memory")
@@ -137,6 +158,28 @@ class Registry:
                               path=prev.path, mtime=prev.mtime,
                               sig=prev.sig)
         obs.count("serve.install", kernel=name, version=entry.version)
+        return entry
+
+    def set_precision(self, name: str, precision: str | None) -> Entry:
+        """Retag ``name``'s serve compute policy as a NEW version (the
+        engine's cache keys carry the version, so fresh executables
+        compile under the new policy while in-flight batches finish on
+        the old ones).  ``None`` clears the per-entry override back to
+        the process default.  Emits the ``serve.precision`` event."""
+        if precision is not None and precision not in PRECISIONS:
+            raise RegistryError(
+                f"unknown precision {precision!r} "
+                f"(want {'|'.join(PRECISIONS)})")
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(name)
+            entry = entry._replace(version=entry.version + 1,
+                                   precision=precision)
+            self._entries[name] = entry
+        obs.event("serve.precision", kernel=name,
+                  precision=precision or "native",
+                  version=entry.version, source="set")
         return entry
 
     # ------------------------------------------------------------ lookup

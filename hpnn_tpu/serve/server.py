@@ -234,6 +234,9 @@ class Session:
             },
         }
         doc["numerics"] = obs.probes.health_doc(self.registry.names())
+        # per-kernel serve precision policy + measured quant_err bound
+        # (engine.precision_doc; docs/performance.md)
+        doc["precision"] = self.engine.precision_doc()
         doc["obs"] = obs.export.health()
         doc["slo"] = obs.slo.health_doc()
         if self.online_health is not None:

@@ -58,11 +58,32 @@ __all__ = [
     "unstack_kernels",
     "member_plan",
     "fleet_plan",
+    "multi_round_plan",
     "make_fleet_epoch_fn",
     "make_member_epoch_fn",
+    "make_fleet_multi_round_fn",
     "train_fleet",
+    "train_fleet_multi",
     "train_sequential",
+    "quant_probe_fleet",
 ]
+
+# Low-precision policy names accepted by the ``dtype=`` knobs below
+# (and by ``HPNN_SERVE_DTYPE`` on the serve side).  bf16 keeps the f32
+# exponent range, so HPNN-sized nets train/serve without rescaling;
+# the error bound is *measured* (``numerics.quant_err``), not assumed.
+TRAIN_DTYPES = ("bf16", "f32", "f64")
+
+
+def _resolve_train_dtype(name):
+    import jax.numpy as jnp
+
+    table = {"bf16": jnp.bfloat16, "f32": jnp.float32,
+             "f64": jnp.float64}
+    if name not in table:
+        raise ValueError(
+            f"unknown train dtype {name!r}; one of {TRAIN_DTYPES}")
+    return table[name]
 
 
 # ------------------------------------------------------------------ stacking
@@ -136,6 +157,25 @@ def fleet_plan(seeds, *, n_rows: int, batch: int, epochs: int,
                          epochs=epochs, refresh=refresh) for s in seeds]
     return (np.stack([p for p, _ in plans]),
             np.stack([o for _, o in plans]))
+
+
+def multi_round_plan(seed_rounds, *, n_rows: int, batch: int,
+                     epochs: int, refresh: int = 8):
+    """Stack :func:`fleet_plan` over K training rounds: given
+    ``seed_rounds[k][i]`` (round ``k``, member ``i``) returns perms
+    ``(N, K, G, n_rows)`` and orders ``(N, K, G, R, S)`` — the index
+    inputs of :func:`make_fleet_multi_round_fn`.  Round ``k`` of the
+    scanned run draws exactly the plan a standalone
+    :func:`train_fleet` call with ``seeds=seed_rounds[k]`` would, so
+    K-round parity against K sequential dispatches is testable."""
+    plans = [fleet_plan(seeds_k, n_rows=n_rows, batch=batch,
+                        epochs=epochs, refresh=refresh)
+             for seeds_k in seed_rounds]
+    n = {p.shape[0] for p, _ in plans}
+    if len(n) != 1:
+        raise ValueError(f"rounds disagree on member count: {sorted(n)}")
+    return (np.stack([p for p, _ in plans], axis=1),
+            np.stack([o for _, o in plans], axis=1))
 
 
 # ------------------------------------------------------------------ epoch fns
@@ -217,6 +257,40 @@ def make_fleet_epoch_fn(n_steps: int, *, model: str = "ann",
     return jax.jit(jax.vmap(run, in_axes=(0, 0, None, None, 0, 0)))
 
 
+def make_fleet_multi_round_fn(n_steps: int, *, model: str = "ann",
+                              momentum: bool = False,
+                              lr: float | None = None,
+                              alpha: float = 0.2, count: bool = True):
+    """Jitted K-round fleet run: the member bank run wrapped in a
+    ``lax.scan`` over the round axis, then vmapped over members —
+    ONE stacked ``jit(vmap(scan))`` executable, so the ~20 us
+    dispatch tax (BENCH_r05) is paid once per K rounds instead of
+    once per round.  ``run(stacked_w, stacked_dw, X, T,
+    perms[N, K, G, n_rows], orders[N, K, G, R, S]) -> (stacked_w,
+    stacked_dw, losses[N, K, G·R, S], counts[N, K, G·R])`` — the
+    per-round losses/counts are carried out of the scan so the ledger
+    and loss reporting see every round, not just the last."""
+    import jax
+    from jax import lax
+
+    lr = dp.default_lr(model, momentum) if lr is None else float(lr)
+    base = _make_bank_run(n_steps, model=model, momentum=momentum,
+                          lr=lr, alpha=alpha, count=count)
+
+    def member(weights, dw, X, T, perms, orders):
+        def round_body(carry, pe):
+            w, m = carry
+            p_k, o_k = pe
+            w, m, losses, counts = base(w, m, X, T, p_k, o_k)
+            return (w, m), (losses, counts)
+
+        (weights, dw), (losses, counts) = lax.scan(
+            round_body, (weights, dw), (perms, orders))
+        return weights, dw, losses, counts
+
+    return jax.jit(jax.vmap(member, in_axes=(0, 0, None, None, 0, 0)))
+
+
 # ------------------------------------------------------------------ training
 def _zeros_dw(stacked_or_weights, momentum: bool):
     import jax.numpy as jnp
@@ -240,7 +314,8 @@ def _record_member_rows(weight_tuples, *, step, where):
 def train_fleet(kernels, X, T, *, epochs: int, batch: int, seeds=None,
                 model: str = "ann", momentum: bool = False,
                 lr: float | None = None, alpha: float = 0.2,
-                refresh: int = 8, count: bool = True):
+                refresh: int = 8, count: bool = True,
+                dtype: str | None = None):
     """Train the whole fleet in one dispatch.
 
     Returns ``(kernels_out, losses[N, epochs, S], counts[N, epochs])``
@@ -249,7 +324,15 @@ def train_fleet(kernels, X, T, *, epochs: int, batch: int, seeds=None,
     a ``train.fleet_round`` span; under ``HPNN_COST`` the
     ``fleet.multi_epoch`` executable is cataloged and its dispatch
     feeds the ``perf.mfu`` family; under a numerics knob each member
-    gets a parity ledger row (see :func:`train_sequential`)."""
+    gets a parity ledger row (see :func:`train_sequential`).
+
+    ``dtype`` opts into the low-precision compute path: weights, dw
+    and the bank are cast once to ``bf16``/``f32`` before the
+    dispatch and the result is cast back to the members' original
+    dtype.  The ledger rows are written from the cast-back weights,
+    so a bf16 run's trajectory can be diffed against an f64 run's
+    ledger with ``tools/ledger_diff.py --vec-tol/--mat-tol`` widened
+    tolerances (:func:`quant_probe_fleet` automates the pair)."""
     import jax
     import jax.numpy as jnp
 
@@ -258,9 +341,16 @@ def train_fleet(kernels, X, T, *, epochs: int, batch: int, seeds=None,
     if len(seeds) != n:
         raise ValueError(f"{len(seeds)} seeds for {n} members")
     stacked = stack_kernels(kernels)
+    host_dtype = np.asarray(kernels[0].weights[0]).dtype
     dw = _zeros_dw(stacked, momentum)
     X = jnp.asarray(X)
     T = jnp.asarray(T)
+    if dtype is not None:
+        jdt = _resolve_train_dtype(dtype)
+        stacked = tuple(w.astype(jdt) for w in stacked)
+        dw = tuple(m.astype(jdt) for m in dw)
+        X = X.astype(jdt)
+        T = T.astype(jdt)
     perms, orders = fleet_plan(seeds, n_rows=X.shape[0], batch=batch,
                                epochs=epochs, refresh=refresh)
     n_steps = X.shape[0] // batch
@@ -282,11 +372,129 @@ def train_fleet(kernels, X, T, *, epochs: int, batch: int, seeds=None,
         obs.cost.record_dispatch("fleet.multi_epoch", dt,
                                  units=n * epochs * n_steps)
     obs.event("fleet.round", members=n, epochs=epochs, batch=batch,
-              steps=n_steps, mode="fleet", dispatch_s=round(dt, 6))
+              steps=n_steps, mode="fleet", dispatch_s=round(dt, 6),
+              dtype=dtype or str(host_dtype))
+    if dtype is not None:
+        # bf16 -> f32 on device (always representable), then host cast
+        # back to the members' dtype; avoids requesting f64 on a
+        # non-x64 backend.
+        stacked = tuple(np.asarray(w.astype(jnp.float32))
+                        .astype(host_dtype) for w in stacked)
+        losses = jnp.asarray(losses, dtype=jnp.float32)
     out = unstack_kernels(stacked)
     _record_member_rows([k.weights for k in out], step=epochs,
                         where="fleet_round")
     return out, np.asarray(losses), np.asarray(counts)
+
+
+def train_fleet_multi(kernels, X, T, *, rounds: int, epochs: int,
+                      batch: int, seed_rounds=None, model: str = "ann",
+                      momentum: bool = False, lr: float | None = None,
+                      alpha: float = 0.2, refresh: int = 8,
+                      count: bool = True, dtype: str | None = None):
+    """Train K rounds of the whole fleet in ONE dispatch.
+
+    The K-round generalization of :func:`train_fleet`: round ``k``
+    uses seeds ``seed_rounds[k]`` (default round-major
+    ``k*N .. k*N+N-1``), and the scanned run is bitwise-equal on CPU
+    f64 to K chained :func:`train_fleet` calls with the same seeds —
+    ``tests/test_quant.py`` proves it through the ledger.  Returns
+    ``(kernels_out, losses[N, rounds, epochs, S],
+    counts[N, rounds, epochs])``.  Emits a ``train.multi_round`` span
+    (with the ``k`` field) and a ``fleet.multi_round`` event; parity
+    ledger rows are written once, from the final weights, so a
+    multi-round ledger pairs row-for-row with the LAST round of a
+    sequential baseline."""
+    import jax
+    import jax.numpy as jnp
+
+    n = len(kernels)
+    if rounds < 1:
+        raise ValueError(f"rounds must be >= 1, got {rounds}")
+    if seed_rounds is None:
+        seed_rounds = [[k * n + i for i in range(n)]
+                       for k in range(rounds)]
+    seed_rounds = [list(s) for s in seed_rounds]
+    if len(seed_rounds) != rounds or any(len(s) != n
+                                         for s in seed_rounds):
+        raise ValueError(
+            f"seed_rounds must be {rounds} rounds x {n} members")
+    stacked = stack_kernels(kernels)
+    host_dtype = np.asarray(kernels[0].weights[0]).dtype
+    dw = _zeros_dw(stacked, momentum)
+    X = jnp.asarray(X)
+    T = jnp.asarray(T)
+    if dtype is not None:
+        jdt = _resolve_train_dtype(dtype)
+        stacked = tuple(w.astype(jdt) for w in stacked)
+        dw = tuple(m.astype(jdt) for m in dw)
+        X = X.astype(jdt)
+        T = T.astype(jdt)
+    perms, orders = multi_round_plan(
+        seed_rounds, n_rows=X.shape[0], batch=batch, epochs=epochs,
+        refresh=refresh)
+    n_steps = X.shape[0] // batch
+    fn = make_fleet_multi_round_fn(n_steps, model=model,
+                                   momentum=momentum, lr=lr,
+                                   alpha=alpha, count=count)
+    units = n * rounds * epochs * n_steps
+    if obs.cost.enabled():
+        obs.cost.analyze_fn("fleet.multi_round", fn, stacked, dw, X, T,
+                            perms, orders, units=units, members=n,
+                            mode="multi_round")
+    obs.gauge("fleet.size", n, where="train_multi")
+    with obs.spans.span("train.multi_round", members=n, k=rounds,
+                        epochs=epochs, mode="multi_round"):
+        t0 = time.perf_counter()
+        stacked, dw, losses, counts = fn(stacked, dw, X, T, perms,
+                                         orders)
+        jax.block_until_ready(stacked)
+        dt = time.perf_counter() - t0
+    if obs.cost.enabled():
+        obs.cost.record_dispatch("fleet.multi_round", dt, units=units)
+    obs.event("fleet.multi_round", members=n, k=rounds, epochs=epochs,
+              batch=batch, steps=n_steps, mode="multi_round",
+              dispatch_s=round(dt, 6), dtype=dtype or str(host_dtype))
+    if dtype is not None:
+        # bf16 -> f32 on device (always representable), then host cast
+        # back to the members' dtype; avoids requesting f64 on a
+        # non-x64 backend.
+        stacked = tuple(np.asarray(w.astype(jnp.float32))
+                        .astype(host_dtype) for w in stacked)
+        losses = jnp.asarray(losses, dtype=jnp.float32)
+    out = unstack_kernels(stacked)
+    _record_member_rows([k.weights for k in out], step=rounds * epochs,
+                        where="fleet_round")
+    return out, np.asarray(losses), np.asarray(counts)
+
+
+def quant_probe_fleet(kernels, X, T, *, epochs: int, batch: int,
+                      seeds=None, dtype: str = "bf16", **kwargs):
+    """Paired low-precision/full-precision fleet round.
+
+    Runs :func:`train_fleet` twice with identical RNG plans — once in
+    the members' native dtype, once under ``dtype`` — and measures
+    ``err = max over members/layers of |low - ref|`` on the resulting
+    weights.  Emits the ``numerics.quant_err`` gauge (the continuously
+    measured error bound the /healthz precision section and the
+    ``--quant`` lint read) and returns ``(out_low, out_ref, err)``.
+    Ledger note: both runs write parity rows under whatever ledger is
+    configured at call time; arm a different ``HPNN_LEDGER`` per run
+    to diff the trajectories with widened tolerances."""
+    out_ref, _, _ = train_fleet(kernels, X, T, epochs=epochs,
+                                batch=batch, seeds=seeds, **kwargs)
+    out_low, _, _ = train_fleet(kernels, X, T, epochs=epochs,
+                                batch=batch, seeds=seeds, dtype=dtype,
+                                **kwargs)
+    err = 0.0
+    for k_low, k_ref in zip(out_low, out_ref):
+        for wl, wr in zip(k_low.weights, k_ref.weights):
+            d = np.max(np.abs(np.asarray(wl, dtype=np.float64)
+                              - np.asarray(wr, dtype=np.float64)))
+            err = max(err, float(d))
+    obs.gauge("numerics.quant_err", err, where="fleet", dtype=dtype,
+              members=len(kernels), epochs=epochs)
+    return out_low, out_ref, err
 
 
 def train_sequential(kernels, X, T, *, epochs: int, batch: int,

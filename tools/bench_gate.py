@@ -94,6 +94,19 @@ GATE_METRICS = {
     # the zero-baseline skip rule like drill_lost_requests)
     "drill_replica_dip_pct": ("lower", 1.00),
     "drill_replica_survivors_lost": ("lower", 2.00),
+    # dispatch-floor + low-precision fold-in (bench.py
+    # bench_multiround / bench_serve_bf16; docs/performance.md): the
+    # K=32 scanned dispatch's effective us/step (the amortized floor —
+    # the acceptance bar is >=5x amortization, the gate guards the
+    # measured trajectory), the paired bf16-vs-f32 serve goodput ratio
+    # (near 1x on CPU where bf16 is emulated, so the tolerance is
+    # wide), and the measured bf16 error bound, which must never
+    # *grow* past its trajectory — the "measured, never assumed"
+    # contract made regression-proof
+    "multiround_effective_us_per_step": ("lower", 0.50),
+    "multiround_amortization_x": ("higher", 0.30),
+    "serve_bf16_goodput_vs_f32": ("higher", 0.30),
+    "serve_bf16_max_abs_err": ("lower", 1.00),
 }
 
 

@@ -58,6 +58,16 @@ rollback that doesn't say which version it restored makes an incident
 unreconstructable, so their shapes (and the promote/rollback version
 bookkeeping) are frozen the same way the ledger rows are.
 
+And the low-precision / multi-round schema lint (:func:`lint_quant`):
+the ``numerics.quant_err`` gauges, ``serve.precision`` events,
+``fleet.multi_round`` events and ``train.multi_round`` spans
+(train/fleet.py, serve/engine.py, serve/registry.py,
+docs/performance.md) are how an operator proves a bf16/int8 policy or
+a K-round scanned dispatch is behaving — a quant-err gauge that can go
+NaN unnoticed, or a multi-round event that can't say K, defeats the
+"measured, never assumed" error-bound contract, so their shapes are
+frozen too.
+
 And the multi-replica routing schema lint
 (:func:`lint_serve_replicas`): the ``router.*`` / ``replica.*``
 records (hpnn_tpu/serve/router.py, docs/serving.md "Scale-out") are
@@ -69,7 +79,7 @@ so their shapes are frozen too.
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
-        [--slo PATH] [--online PATH] [--chaos PATH]
+        [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
         [--serve-replicas PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
@@ -97,7 +107,8 @@ DOC_RE = re.compile(
 )
 
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
-             "docs/fleet.md", "docs/online.md", "docs/resilience.md")
+             "docs/fleet.md", "docs/online.md", "docs/resilience.md",
+             "docs/performance.md")
 SRC_DIR = "hpnn_tpu"
 
 
@@ -684,6 +695,129 @@ def lint_online(path: str) -> list[str]:
     return failures
 
 
+# the low-precision / multi-round record contracts (train/fleet.py,
+# serve/engine.py, serve/registry.py; docs/performance.md)
+QUANT_PRECISIONS = ("bf16", "f32", "f64", "int8", "native")
+QUANT_WHERES = ("serve", "fleet")
+PRECISION_SOURCES = ("set", "warmup")
+
+
+def lint_quant(path: str) -> list[str]:
+    """Schema-lint the low-precision / multi-round records of one
+    metrics sink.
+
+    Checks, per record:
+
+    * ``numerics.quant_err`` — ``kind == "gauge"``, finite
+      NON-NEGATIVE ``value`` (it is a max-abs error: NaN/inf or a
+      negative reading means the probe itself is broken), and a
+      ``where`` of ``serve`` (engine warmup probe) or ``fleet``
+      (:func:`quant_probe_fleet`).
+    * ``serve.precision`` events — ``kind == "event"``, non-empty
+      ``kernel``, ``precision`` one of
+      ``bf16/f32/f64/int8/native``, ``version`` an int >= 0, and
+      ``source`` ``set`` (registry retag) or ``warmup`` (engine).
+    * ``fleet.multi_round`` events — ``members``/``k``/``epochs``
+      ints >= 1 (the whole point of the scanned dispatch is K >= 1
+      rounds over a live fleet) and a non-negative ``dispatch_s``.
+    * ``span.end`` records named ``train.multi_round`` — ``members``
+      and ``k`` ints >= 1, so a slow scanned dispatch is
+      attributable to its round count.
+
+    A sink with none of these records fails — this lint only makes
+    sense on a run where the multi-round scan or a low-precision
+    policy was actually armed (``HPNN_ONLINE_SCAN_K`` /
+    ``HPNN_SERVE_DTYPE`` / a per-entry precision).  Returns failure
+    strings (empty = pass).
+    """
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_quant = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev == "numerics.quant_err":
+            n_quant += 1
+            if rec.get("kind") != "gauge":
+                failures.append(
+                    f"{at}: numerics.quant_err kind "
+                    f"{rec.get('kind')!r} != 'gauge'")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: numerics.quant_err value {v!r} is not a "
+                    "finite non-negative number")
+            if rec.get("where") not in QUANT_WHERES:
+                failures.append(
+                    f"{at}: numerics.quant_err where "
+                    f"{rec.get('where')!r} not in "
+                    f"{'/'.join(QUANT_WHERES)}")
+        elif ev == "serve.precision":
+            n_quant += 1
+            if rec.get("kind") != "event":
+                failures.append(
+                    f"{at}: serve.precision kind "
+                    f"{rec.get('kind')!r} != 'event'")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: serve.precision kernel {k!r} is not a "
+                    "non-empty string")
+            if rec.get("precision") not in QUANT_PRECISIONS:
+                failures.append(
+                    f"{at}: serve.precision precision "
+                    f"{rec.get('precision')!r} not in "
+                    f"{'/'.join(QUANT_PRECISIONS)}")
+            v = rec.get("version")
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                failures.append(
+                    f"{at}: serve.precision version {v!r} is not a "
+                    "non-negative int")
+            if rec.get("source") not in PRECISION_SOURCES:
+                failures.append(
+                    f"{at}: serve.precision source "
+                    f"{rec.get('source')!r} not in "
+                    f"{'/'.join(PRECISION_SOURCES)}")
+        elif ev == "fleet.multi_round":
+            n_quant += 1
+            for key in ("members", "k", "epochs"):
+                if not _pos_int(rec.get(key)):
+                    failures.append(
+                        f"{at}: fleet.multi_round {key} "
+                        f"{rec.get(key)!r} is not an int >= 1")
+            ds = rec.get("dispatch_s")
+            if not _num(ds) or ds < 0:
+                failures.append(
+                    f"{at}: fleet.multi_round dispatch_s {ds!r} is "
+                    "not a non-negative number")
+        elif ev == "span.end" and rec.get("name") == "train.multi_round":
+            n_quant += 1
+            for key in ("members", "k"):
+                if not _pos_int(rec.get(key)):
+                    failures.append(
+                        f"{at}: train.multi_round span {key} "
+                        f"{rec.get(key)!r} is not an int >= 1")
+    if not n_quant:
+        failures.append(
+            f"sink {path!r} has no multi-round / precision records — "
+            "were HPNN_ONLINE_SCAN_K / HPNN_SERVE_DTYPE (or a "
+            "per-entry precision) armed during this run?")
+    return failures
+
+
 # the chaos/durability record contracts (hpnn_tpu/chaos/,
 # hpnn_tpu/online/wal.py, tools/chaos_drill.py; docs/resilience.md)
 CHAOS_ACTIONS = ("kill", "raise", "delay", "nan")
@@ -1050,6 +1184,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_online(argv[i + 1])
+    if "--quant" in argv:
+        i = argv.index("--quant")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --quant needs a "
+                             "path\n")
+            return 2
+        failures += lint_quant(argv[i + 1])
     if "--chaos" in argv:
         i = argv.index("--chaos")
         if i + 1 >= len(argv):

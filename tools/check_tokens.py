@@ -165,7 +165,17 @@ def check(tmpdir: str) -> list[str]:
                      ("HPNN_ONLINE_EPOCHS", "2"),
                      ("HPNN_ONLINE_INTERVAL_S", "60"),
                      ("HPNN_ONLINE_MARGIN", "0.0"),
-                     ("HPNN_ONLINE_WATCH_S", "5"))
+                     ("HPNN_ONLINE_WATCH_S", "5"),
+                     # K-rounds-per-dispatch (docs/performance.md):
+                     # read only by the online trainer, so arming it
+                     # during a plain round must be inert
+                     ("HPNN_ONLINE_SCAN_K", "4"),
+                     # low-precision serve policy (docs/performance.md)
+                     # — read only by serve.Engine at construction;
+                     # rides the HPNN_ONLINE_* inertness proof and the
+                     # ledger run-pair below proves the bf16 knob is
+                     # zero-perturbation on the train path when armed
+                     ("HPNN_SERVE_DTYPE", "bf16"))
     # chaos + durability (docs/resilience.md) ride the same proof: an
     # ARMED plan whose seams never trigger on the train path (the
     # delay fault targets a real serve seam; the train round never
@@ -207,7 +217,8 @@ def check(tmpdir: str) -> list[str]:
             "stdout is NOT byte-identical with HPNN_METRICS + "
             "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
             "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + HPNN_CHAOS + "
-            "HPNN_WAL_DIR + HPNN_ONLINE_* + export server all enabled "
+            "HPNN_WAL_DIR + HPNN_ONLINE_* (incl. HPNN_ONLINE_SCAN_K) + "
+            "HPNN_SERVE_DTYPE=bf16 + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
     if os.path.exists(os.path.join(wal_dir, wal_mod.WAL_NAME)):
         failures.append(
@@ -402,6 +413,10 @@ def check(tmpdir: str) -> list[str]:
     # the probes' stats dispatch is a separate executable, so enabling
     # it cannot move the training trajectory (f64 CPU runs of the same
     # seed are bit-identical; equal abs-sums here mean equal weights).
+    # Run b also had HPNN_SERVE_DTYPE=bf16 and HPNN_ONLINE_SCAN_K=4
+    # armed, so checksum equality here is ALSO the proof that the
+    # low-precision serve policy and the K-round scan knob are
+    # zero-perturbation when their subsystems aren't in the path.
     ledger_d = os.path.join(tmpdir, "ledger_d.jsonl")
     os.environ["HPNN_LEDGER"] = ledger_d
     try:
