@@ -484,6 +484,35 @@ def bench_batch():
     return out
 
 
+def _tiny_round_conf(d: str):
+    """The check_tokens 6-sample 8->5->2 shape: writes the sample set
+    under ``d`` and returns a fresh-conf factory for paired rounds."""
+    from hpnn_tpu.config import NNConf, NNTrain, NNType
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    rng = np.random.RandomState(0)
+    sdir = os.path.join(d, "samples")
+    os.makedirs(sdir)
+    for i in range(6):
+        c = i % 2
+        x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
+            + 0.1 * rng.normal(size=8)
+        t = np.full(2, -1.0)
+        t[c] = 1.0
+        with open(os.path.join(sdir, f"s{i:05d}.txt"), "w") as fp:
+            fp.write("[input] 8\n"
+                     + " ".join(f"{v:.5f}" for v in x) + "\n")
+            fp.write("[output] 2\n"
+                     + " ".join(f"{v:.1f}" for v in t) + "\n")
+
+    def conf():
+        k, _ = kernel_mod.generate(7, 8, [5], 2)
+        return NNConf(name="b", type=NNType.ANN, seed=1, kernel=k,
+                      train=NNTrain.BP, samples=sdir, tests=sdir)
+
+    return conf
+
+
 def bench_obs_overhead(repeats: int = 5):
     """Paired measurement of the obs subsystem's cost: the SAME tiny
     fused train round (the check_tokens 6-sample 8->5->2 shape) with
@@ -491,32 +520,12 @@ def bench_obs_overhead(repeats: int = 5):
     each pair shares machine conditions.  Quantifies the design claim
     that instrumentation is cheap when on and free when off."""
     from hpnn_tpu import obs
-    from hpnn_tpu.config import NNConf, NNTrain, NNType
-    from hpnn_tpu.models import kernel as kernel_mod
     from hpnn_tpu.train import driver
 
     prev_sink = obs.sink_path() if obs.enabled() else None
     d = tempfile.mkdtemp(prefix="hpnn_obs_bench_")
     try:
-        rng = np.random.RandomState(0)
-        sdir = os.path.join(d, "samples")
-        os.makedirs(sdir)
-        for i in range(6):
-            c = i % 2
-            x = (1 - 2 * c) * np.r_[np.ones(4), -np.ones(4)] \
-                + 0.1 * rng.normal(size=8)
-            t = np.full(2, -1.0)
-            t[c] = 1.0
-            with open(os.path.join(sdir, f"s{i:05d}.txt"), "w") as fp:
-                fp.write("[input] 8\n"
-                         + " ".join(f"{v:.5f}" for v in x) + "\n")
-                fp.write("[output] 2\n"
-                         + " ".join(f"{v:.1f}" for v in t) + "\n")
-
-        def conf():
-            k, _ = kernel_mod.generate(7, 8, [5], 2)
-            return NNConf(name="b", type=NNType.ANN, seed=1, kernel=k,
-                          train=NNTrain.BP, samples=sdir, tests=sdir)
+        conf = _tiny_round_conf(d)
 
         # warm both paths (compile caches, sink open)
         obs.configure(None)
@@ -546,6 +555,83 @@ def bench_obs_overhead(repeats: int = 5):
         }
     finally:
         obs.configure(prev_sink)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def bench_collector_overhead(repeats: int = 5):
+    """Paired measurement of the fleet telemetry plane's MARGINAL
+    cost: the same tiny fused round with the JSONL sink armed in BOTH
+    legs, plus — in the "on" leg only — a live collector receiving
+    the push client's batches and an ``HPNN_ALERTS`` threshold rule
+    that actually fires on the round's own ``fuse.chunk_size`` gauge.
+    Quantifies the ISSUE 12 claim that telemetry never backpressures
+    the hot path (tools/bench_gate.py gates
+    ``collector_overhead_pct``)."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.obs import collector as collector_mod
+    from hpnn_tpu.train import driver
+
+    prev_sink = obs.sink_path() if obs.enabled() else None
+    d = tempfile.mkdtemp(prefix="hpnn_coll_bench_")
+    server = collector_mod.start_collector()
+    port = server.server_address[1]
+    saved = {k: os.environ.pop(k, None)
+             for k in ("HPNN_COLLECTOR", "HPNN_COLLECTOR_FLUSH_S",
+                       "HPNN_ALERTS")}
+
+    def arm(on: bool, sink: str) -> None:
+        # obs.configure re-runs the reset chain, so the collector
+        # client + alert rules re-read the env on the next emit
+        if on:
+            os.environ["HPNN_COLLECTOR"] = f"http://127.0.0.1:{port}"
+            os.environ["HPNN_ALERTS"] = \
+                "bench_chunk@fuse.chunk_size>0:cooldown=0"
+        else:
+            os.environ.pop("HPNN_COLLECTOR", None)
+            os.environ.pop("HPNN_ALERTS", None)
+        obs.configure(sink)
+
+    try:
+        conf = _tiny_round_conf(d)
+
+        # warm both legs (compile caches, sink open, client thread)
+        arm(False, os.path.join(d, "warm_off.jsonl"))
+        driver.train_kernel(conf())
+        arm(True, os.path.join(d, "warm_on.jsonl"))
+        driver.train_kernel(conf())
+
+        on_s, off_s = [], []
+        for r in range(repeats):
+            arm(False, os.path.join(d, f"off{r}.jsonl"))
+            t0 = time.perf_counter()
+            driver.train_kernel(conf())
+            off_s.append(time.perf_counter() - t0)
+            arm(True, os.path.join(d, f"on{r}.jsonl"))
+            t0 = time.perf_counter()
+            driver.train_kernel(conf())
+            on_s.append(time.perf_counter() - t0)
+        deltas = [round(100.0 * (a - b) / b, 2)
+                  for a, b in zip(on_s, off_s)]
+        return {
+            "round_s_collector_off": _stats([round(v, 4) for v in off_s]),
+            "round_s_collector_on": _stats([round(v, 4) for v in on_s]),
+            "paired_overhead_pct": {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            },
+            # the proof the "on" leg measured a LIVE pipeline, not a
+            # dead URL shedding batches
+            "collector_records_total": server.collector.records_total,
+        }
+    finally:
+        obs.configure(None)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        obs.configure(prev_sink)
+        collector_mod.stop_collector(server)
         shutil.rmtree(d, ignore_errors=True)
 
 
@@ -919,6 +1005,16 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["obs_overhead_error"] = repr(exc)
 
+    # fleet telemetry overhead: the same paired shape with the sink
+    # armed in both legs and a live collector + firing alert rule in
+    # one (docs/observability.md "Fleet telemetry") — rides the same
+    # skip knob, best-effort like the other fold-ins
+    if not os.environ.get("HPNN_BENCH_NO_OBS_OVERHEAD"):
+        try:
+            out["collector_overhead"] = bench_collector_overhead()
+        except Exception as exc:
+            out["collector_overhead_error"] = repr(exc)
+
     # HPNN_METRICS: the bench subprocesses/rounds inherit the knob, so
     # the run's structured events land in the sink — record where, and
     # fold obs_report's machine summary in (best-effort: a torn sink
@@ -1058,6 +1154,22 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["replica_drill_error"] = repr(exc)
 
+    # Alert drill (tools/chaos_drill.py run_bench_alert_drill): kill a
+    # router replica under load with a threshold rule armed on the
+    # router.ready_replicas gauge, prove alert.fire (flight dump
+    # attached) then alert.resolve after the respawn
+    # (docs/observability.md "Fleet telemetry").  Rides the same
+    # HPNN_BENCH_NO_DRILL knob (in-process, a few seconds).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["alert_drill"] = chaos_drill.run_bench_alert_drill()
+        except Exception as exc:
+            out["alert_drill_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -1160,9 +1272,18 @@ def main(argv=None) -> None:
         rd = out["replica_drill"]
         compact["drill_replica_dip_pct"] = rd["goodput_dip_pct"]
         compact["drill_replica_survivors_lost"] = rd["survivors_lost"]
+    if ("alert_drill" in out
+            and out["alert_drill"].get("fire_s") is not None):
+        ad = out["alert_drill"]
+        compact["drill_alert_fire_s"] = ad["fire_s"]
+        compact["drill_alert_resolved"] = ad["resolved"]
     if "obs_overhead" in out:
         compact["obs_overhead_pct"] = (
             out["obs_overhead"]["paired_overhead_pct"]["median"]
+        )
+    if "collector_overhead" in out:
+        compact["collector_overhead_pct"] = (
+            out["collector_overhead"]["paired_overhead_pct"]["median"]
         )
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:

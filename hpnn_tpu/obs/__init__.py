@@ -37,7 +37,19 @@ structured side channel next to it:
   and error-budget burn rate — ``HPNN_SLO_MS`` (obs/slo.py), exported
   as ``slo.*`` gauges on ``/metrics`` and the ``/healthz`` verdict,
   and feeding the batcher's SLO-driven load shedding
-  (serve/batcher.py; load harness: tools/loadgen.py).
+  (serve/batcher.py; load harness: tools/loadgen.py);
+* the fleet telemetry plane: cross-process trace propagation over
+  ``X-Trace-Id``/``X-Parent-Span`` headers so span trees stitch
+  across the loadgen → edge → router → replica → online-trainer
+  chain (obs/propagate.py, rides ``HPNN_SPANS``), a central
+  collector workers push batched records to — bounded queues with
+  drop-with-count on overload at both hops, fleet aggregates on
+  ``/metrics`` + ``/fleetz`` — ``HPNN_COLLECTOR=<url>``
+  (obs/collector.py, ``cli/obs_collector.py``), and a rule engine
+  over gauge streams with threshold / SLO burn-rate / EWMA z-score
+  rules firing ``alert.fire``/``alert.resolve`` with a flight dump
+  attached — ``HPNN_ALERTS`` (obs/alerts.py; drill:
+  ``tools/chaos_drill.py --drill alert``).
 
 Typical instrumentation site::
 
@@ -51,8 +63,9 @@ Typical instrumentation site::
 Event-name catalog and schema: docs/observability.md.
 """
 
-from hpnn_tpu.obs import (cost, device, export, flight, ledger, probes,
-                          slo, spans)
+from hpnn_tpu.obs import (alerts, collector, cost, device, export,
+                          flight, ledger, probes, propagate, slo,
+                          spans)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -74,7 +87,9 @@ from hpnn_tpu.obs.registry import (
 __all__ = [
     "ENV_KNOB",
     "activate_memory",
+    "alerts",
     "annotate",
+    "collector",
     "configure",
     "cost",
     "count",
@@ -88,6 +103,7 @@ __all__ = [
     "ledger",
     "observe",
     "probes",
+    "propagate",
     "sink_path",
     "slo",
     "snapshot_state",

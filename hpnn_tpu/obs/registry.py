@@ -165,6 +165,13 @@ _memory_requested = False
 _handlers_installed = False
 _prev_excepthook = None
 
+# lazily-armed fan-out hooks (obs/collector.py push client and
+# obs/alerts.py rule engine).  Module-level callables — one ``is not
+# None`` check per record / per gauge when armed, nothing at all when
+# the knobs are unset.  _init arms them; _reset_for_tests disarms.
+_push_hook = None   # called with each serialized JSONL line
+_gauge_hook = None  # called with (name, float_value) per gauge
+
 
 def _to_py(o):
     # numpy scalars and other array-likes carrying .item()
@@ -210,7 +217,9 @@ def _init():
             # without a sink
             if not (_memory_requested or flight.enabled()
                     or os.environ.get("HPNN_SPANS")
-                    or os.environ.get("HPNN_COST")):
+                    or os.environ.get("HPNN_COST")
+                    or os.environ.get("HPNN_COLLECTOR")
+                    or os.environ.get("HPNN_ALERTS")):
                 _state = False
                 return False
             path = None
@@ -218,6 +227,16 @@ def _init():
         _state = st
         atexit.register(_at_exit)
     _install_crash_handlers()
+    # arm the fleet-telemetry hooks (local imports: collector/alerts
+    # import registry, so importing them at module scope would cycle)
+    if os.environ.get("HPNN_COLLECTOR"):
+        from hpnn_tpu.obs import collector
+
+        collector._install_push()
+    if os.environ.get("HPNN_ALERTS"):
+        from hpnn_tpu.obs import alerts
+
+        alerts._install()
     _emit(st, {"ev": "obs.open", "kind": "event", "pid": os.getpid(),
                "rank": _process_index()})
     return st
@@ -234,6 +253,9 @@ def _emit(st: _State, rec: dict) -> None:
     rec.setdefault("ts", round(time.time(), 6))
     line = json.dumps(rec, default=_to_py)
     flight.record(line)
+    hook = _push_hook
+    if hook is not None:
+        hook(line)  # O(1) enqueue-or-drop; never blocks (collector.py)
     if st.fp is not None:
         with st.lock:
             st.fp.write(line + "\n")
@@ -329,6 +351,9 @@ def gauge(name: str, value, **fields) -> None:
     rec = {"ev": name, "kind": "gauge", "value": v}
     rec.update(fields)
     _emit(st, rec)
+    hook = _gauge_hook
+    if hook is not None:
+        hook(name, v)  # alert rule evaluation (obs/alerts.py)
 
 
 def observe(name: str, values, **fields) -> None:
@@ -508,11 +533,14 @@ def _reset_for_tests() -> None:
     and any file-less activation.  Test-only — production code
     re-points the sink through :func:`configure`."""
     global _state, _memory_requested, _signal_flushed
+    global _push_hook, _gauge_hook
     with _state_lock:
         st = _state
         _state = None
         _memory_requested = False
         _signal_flushed = False
+        _push_hook = None
+        _gauge_hook = None
         if isinstance(st, _State) and st.fp is not None:
             try:
                 st.fp.close()
@@ -524,6 +552,8 @@ def _reset_for_tests() -> None:
     for name in ("hpnn_tpu.obs.export", "hpnn_tpu.obs.ledger",
                  "hpnn_tpu.obs.probes", "hpnn_tpu.obs.cost",
                  "hpnn_tpu.obs.spans", "hpnn_tpu.obs.slo",
+                 "hpnn_tpu.obs.propagate", "hpnn_tpu.obs.collector",
+                 "hpnn_tpu.obs.alerts",
                  "hpnn_tpu.chaos", "hpnn_tpu.online.wal"):
         mod = sys.modules.get(name)
         if mod is not None:

@@ -129,9 +129,27 @@ class Promoter:
         return reason
 
     def consider(self, name: str, candidate_weights, eval_set, *,
-                 step: int) -> str:
+                 step: int, trace=None) -> str:
         """Run the full gate over one candidate; returns "promoted"
-        or the rejection reason ("sentinel" | "margin" | "eval")."""
+        or the rejection reason ("sentinel" | "margin" | "eval").
+        With spans armed the verdict runs under an
+        ``online.promote_gate`` span parented to ``trace`` (the
+        trainer's round context) — the tail of the ingest → trainer →
+        promote causal chain (docs/observability.md)."""
+        gspan = obs.spans.start("online.promote_gate", kernel=name,
+                                step=step,
+                                **obs.propagate.fields(trace))
+        try:
+            outcome = self._consider(name, candidate_weights, eval_set,
+                                     step=step)
+        except BaseException as exc:
+            obs.spans.finish(gspan, failed=type(exc).__name__)
+            raise
+        obs.spans.finish(gspan, outcome=outcome)
+        return outcome
+
+    def _consider(self, name: str, candidate_weights, eval_set, *,
+                  step: int) -> str:
         ws = tuple(np.asarray(w) for w in candidate_weights)
         # host finiteness sweep: always on — the gate itself must not
         # depend on any obs knob being armed

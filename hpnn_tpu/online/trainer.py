@@ -225,11 +225,20 @@ class OnlineTrainer:
                           for w in entry.kernel.weights))
             groups.setdefault(topo, []).append(entry)
         candidates: dict = {}
+        # the ingest → trainer → promote causal chain: the round span
+        # parents back to the serve edge's most recent ingest request
+        # (obs/propagate.py slots), and the promotion verdict parents
+        # under the round span — one cross-process tree from the
+        # loadgen POST /ingest to the install (docs/observability.md)
+        ictx = obs.propagate.peek("ingest")
         with obs.spans.span("online.train_round", round=self._round,
                             members=len(names), rows=self.rows,
-                            replay=meta["replay"]):
+                            replay=meta["replay"],
+                            **obs.propagate.fields(ictx)) as rspan:
             for entries in groups.values():
                 candidates.update(self._train_group(entries, X, T))
+        rctx = obs.propagate.ctx_from(
+            rspan, trace=getattr(ictx, "trace", None))
         train_s = self._clock() - t0
         eval_set = (self.eval_set if self.eval_set is not None
                     else self.buffer.eval_snapshot())
@@ -245,7 +254,8 @@ class OnlineTrainer:
                 if hooked is not None:
                     cand = hooked
             outcome = self.promoter.consider(name, cand, eval_set,
-                                             step=self._round)
+                                             step=self._round,
+                                             trace=rctx)
             summary["outcomes"][name] = outcome
             if outcome == "promoted":
                 summary["promoted"] += 1

@@ -185,6 +185,7 @@ class Router:
         # the online-learning layer plugs in exactly as on a Session
         self.ingest_hook = None
         self.online_health = None
+        self._emit_ready_gauge()
 
     # ------------------------------------------------------------ plumbing
     def _primary(self) -> Replica:
@@ -209,6 +210,16 @@ class Router:
 
     def _live(self) -> list[Replica]:
         return [rep for rep in self.replicas if not rep._closed]
+
+    def _emit_ready_gauge(self) -> None:
+        """``router.ready_replicas``: live AND ready replica count,
+        re-emitted on every membership edge (init, kill, spawn) — the
+        gauge the replica-loss alert rule watches
+        (docs/observability.md "Fleet telemetry")."""
+        n = sum(1 for rep in self.replicas
+                if not rep._closed and rep.is_ready())
+        obs.gauge("router.ready_replicas", float(n),
+                  total=len(self.replicas))
 
     def _fan(self, op: str, fn, name: str, *, versioned: bool = True):
         """Run ``fn(replica)`` on every live replica, rank order,
@@ -342,6 +353,7 @@ class Router:
         doc["numerics"] = obs.probes.health_doc(primary.registry.names())
         doc["obs"] = obs.export.health()
         doc["slo"] = obs.slo.health_doc()
+        doc["alerts"] = obs.alerts.health_doc()
         if self.online_health is not None:
             doc["online"] = self.online_health()
         return doc
@@ -366,57 +378,78 @@ class Router:
                                              rep.rank))
 
     def infer(self, name: str, x, *, timeout_s: float = 5.0,
-              req_id: str | None = None):
+              req_id: str | None = None, trace=None):
         """Route one request (same contract as ``Session.infer``).
 
         Placement is least-outstanding over ready replicas; a
         :class:`Shed`/:class:`QueueFull` answer cools that replica and
         retries the next-best one.  Oversized row blocks spill to the
         TP path when enabled.  Raises ``KeyError`` for unknown
-        kernels, the last replica's rejection when all refuse."""
+        kernels, the last replica's rejection when all refuse.
+
+        With spans armed the routing hop is its own ``router.request``
+        span parented to the caller's ``trace`` context, and each
+        replica dispatch parents under it — the edge → router →
+        replica chain in one tree (docs/observability.md)."""
         arr = np.asarray(x)
         single = arr.ndim == 1
         n_rows = 1 if single else int(np.atleast_2d(arr).shape[0])
         entry = self._primary().registry.get(name)   # KeyError: unknown
-        if (self.spill and not single
-                and n_rows > self._primary().engine.buckets[-1]):
-            out = self._spill_infer(entry, np.atleast_2d(arr))
-            return out
-        last_exc: Exception | None = None
-        for rep in self._candidates():
-            depth = rep.begin_request(n_rows)
-            obs.count("router.route", rank=rep.rank, kernel=name,
-                      rows=n_rows)
-            obs.gauge("replica.outstanding", float(depth),
-                      rank=rep.rank)
-            try:
-                return rep.infer(name, arr, timeout_s=timeout_s,
-                                 req_id=req_id)
-            except Shed as exc:
-                with self._cool_lock:
-                    self._cool[rep.rank] = (self._clock()
-                                            + exc.retry_after_s)
-                obs.count("router.shed_around", rank=rep.rank,
-                          kernel=name, reason=exc.reason)
-                last_exc = exc
-            except QueueFull as exc:
-                obs.count("router.shed_around", rank=rep.rank,
-                          kernel=name, reason="queue_full")
-                last_exc = exc
-            except RuntimeError as exc:
-                # a replica closed mid-route (kill_replica racing the
-                # candidate snapshot): route around it like a shed
-                if "closed" not in str(exc):
-                    raise
-                obs.count("router.shed_around", rank=rep.rank,
-                          kernel=name, reason="closed")
-                last_exc = exc
-            finally:
-                rep.end_request(n_rows)
-        if last_exc is not None:
-            raise last_exc
-        raise Shed("no ready replica", reason="no_replica",
-                   retry_after_s=1.0)
+        rfields = {"kernel": name, "rows": n_rows}
+        if req_id is not None:
+            rfields["req_id"] = req_id
+        rfields.update(obs.propagate.fields(trace))
+        rspan = obs.spans.start("router.request", **rfields)
+        sub = obs.propagate.ctx_from(
+            rspan, trace=getattr(trace, "trace", None))
+        try:
+            if (self.spill and not single
+                    and n_rows > self._primary().engine.buckets[-1]):
+                out = self._spill_infer(entry, np.atleast_2d(arr))
+                obs.spans.finish(rspan, spilled=True)
+                return out
+            last_exc: Exception | None = None
+            for rep in self._candidates():
+                depth = rep.begin_request(n_rows)
+                obs.count("router.route", rank=rep.rank, kernel=name,
+                          rows=n_rows)
+                obs.gauge("replica.outstanding", float(depth),
+                          rank=rep.rank)
+                try:
+                    out = rep.infer(name, arr, timeout_s=timeout_s,
+                                    req_id=req_id, trace=sub)
+                    obs.spans.finish(rspan, rank=rep.rank)
+                    return out
+                except Shed as exc:
+                    with self._cool_lock:
+                        self._cool[rep.rank] = (self._clock()
+                                                + exc.retry_after_s)
+                    obs.count("router.shed_around", rank=rep.rank,
+                              kernel=name, reason=exc.reason)
+                    last_exc = exc
+                except QueueFull as exc:
+                    obs.count("router.shed_around", rank=rep.rank,
+                              kernel=name, reason="queue_full")
+                    last_exc = exc
+                except RuntimeError as exc:
+                    # a replica closed mid-route (kill_replica racing
+                    # the candidate snapshot): route around it like a
+                    # shed
+                    if "closed" not in str(exc):
+                        raise
+                    obs.count("router.shed_around", rank=rep.rank,
+                              kernel=name, reason="closed")
+                    last_exc = exc
+                finally:
+                    rep.end_request(n_rows)
+            if last_exc is not None:
+                raise last_exc
+            raise Shed("no ready replica", reason="no_replica",
+                       retry_after_s=1.0)
+        except BaseException as exc:
+            # idempotent: a success path already finished the span
+            obs.spans.finish(rspan, failed=type(exc).__name__)
+            raise
 
     # ------------------------------------------------------------ TP spill
     def _tp_forward(self, entry):
@@ -470,6 +503,7 @@ class Router:
         rep.close()
         obs.event("router.replica_down", rank=rank,
                   survivors=len(self._live()))
+        self._emit_ready_gauge()
 
     def spawn_replica(self) -> Replica:
         """Pre-warmed spin-up: a new replica cloning the current
@@ -491,6 +525,7 @@ class Router:
             self.replicas.append(rep)
         obs.event("router.replica_up", rank=rank,
                   kernels=len(rep.registry.names()))
+        self._emit_ready_gauge()
         return rep
 
     # ------------------------------------------------------------ close

@@ -22,6 +22,12 @@ same Session, for drivers that aren't Python:
   ``{"kernel": n?, "inputs": [...], "targets": [...]}`` → feed the
   online-learning sample buffer when an ``OnlineSession`` is attached
   (hpnn_tpu/online/; docs/online.md); 404 on a plain serving process.
+  Carries the same ``X-Request-Id`` echo as ``/v1/infer`` and runs
+  under a ``serve.ingest`` span parented to the caller's trace.
+
+Both POST data routes adopt ``X-Trace-Id``/``X-Parent-Span`` request
+headers (obs/propagate.py) so the request's span tree parents across
+the process boundary, and echo ``X-Trace-Id`` back.
 * ``GET /healthz`` → **liveness**: always 200 while the process can
   answer — kernel/bucket census, bucket-compile count, per-kernel
   queue depth + oldest-waiter age + shed/expired counters, SLO
@@ -239,6 +245,7 @@ class Session:
         doc["precision"] = self.engine.precision_doc()
         doc["obs"] = obs.export.health()
         doc["slo"] = obs.slo.health_doc()
+        doc["alerts"] = obs.alerts.health_doc()
         if self.online_health is not None:
             doc["online"] = self.online_health()
         return doc
@@ -279,7 +286,7 @@ class Session:
         return b
 
     def infer(self, name: str, x, *, timeout_s: float = 5.0,
-              req_id: str | None = None):
+              req_id: str | None = None, trace=None):
         """Forward ``x`` through kernel ``name`` via the micro-batcher.
 
         ``x`` may be one input vector ``(n_in,)`` → returns
@@ -288,7 +295,10 @@ class Session:
         :class:`QueueFull` / :class:`DeadlineExceeded` (retriable).
         ``req_id`` (HTTP-edge minted) is threaded onto the request's
         spans and the outcome lands in the SLO tracker
-        (``HPNN_SLO_MS``; obs/slo.py).
+        (``HPNN_SLO_MS``; obs/slo.py).  ``trace`` (an
+        ``obs.propagate.Ctx`` from the wire, or from an upstream
+        Router hop) parents this request's span tree to the remote
+        caller's (docs/observability.md "Fleet telemetry").
         """
         arr = np.asarray(x)
         single = arr.ndim == 1
@@ -300,6 +310,7 @@ class Session:
         sfields = {"kernel": name, "rows": rows.shape[0]}
         if req_id is not None:
             sfields["req_id"] = req_id
+        sfields.update(obs.propagate.fields(trace))
         span = obs.spans.start("serve.request", **sfields)
         slo_on = obs.slo.enabled()
         t0 = self._clock() if slo_on else 0.0
@@ -452,9 +463,17 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(req_id, str) or not req_id:
             req_id = f"{os.getpid():x}-{next(_REQ_IDS):x}"
         rid_hdr = {"X-Request-Id": req_id}
+        # adopt the caller's X-Trace-Id/X-Parent-Span (or mint a trace
+        # at the edge) so the span tree parents across the process
+        # boundary; the trace id is echoed like the request id
+        tctx = obs.propagate.extract(self.headers)
+        if tctx is None and obs.propagate.enabled():
+            tctx = obs.propagate.Ctx(obs.propagate.new_trace())
+        if tctx is not None and tctx.trace:
+            rid_hdr["X-Trace-Id"] = tctx.trace
         try:
             out = self.session.infer(name, inputs, timeout_s=timeout_s,
-                                     req_id=req_id)
+                                     req_id=req_id, trace=tctx)
         except KeyError:
             self._reply(404, {"error": f"unknown kernel {name!r}",
                               "req_id": req_id}, headers=rid_hdr)
@@ -481,39 +500,79 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _ingest(self, req: dict):
         """``POST /ingest`` ``{"kernel": n?, "inputs": [[...]],
-        "targets": [[...]]}`` → ``{"accepted": N, "depth": D}``.
-        Feeds the online-learning sample buffer; 404 when no online
-        session is attached (plain serving process) or the kernel is
-        unknown, 400 on malformed/width-mismatched samples."""
+        "targets": [[...]], "req_id": id?}`` → ``{"accepted": N,
+        "depth": D, "req_id": id}``.  Feeds the online-learning sample
+        buffer; 404 when no online session is attached (plain serving
+        process) or the kernel is unknown, 400 on
+        malformed/width-mismatched samples.
+
+        Like ``/v1/infer``, every response carries an
+        ``X-Request-Id`` echo (client-sent ``req_id`` honored, else
+        edge-minted) and, with spans armed, the ingest runs under a
+        ``serve.ingest`` span parented to the caller's trace context —
+        mixed ``loadgen --mix`` traffic is fully traceable.  The
+        context is additionally noted for the online trainer
+        (``obs.propagate.note``), parenting the training round the
+        ingested rows later drive back to this request."""
         if self._not_ready():
             return
+        req_id = req.get("req_id")
+        if not isinstance(req_id, str) or not req_id:
+            req_id = f"{os.getpid():x}-{next(_REQ_IDS):x}"
+        rid_hdr = {"X-Request-Id": req_id}
+        tctx = obs.propagate.extract(self.headers)
+        if tctx is None and obs.propagate.enabled():
+            tctx = obs.propagate.Ctx(obs.propagate.new_trace())
+        if tctx is not None and tctx.trace:
+            rid_hdr["X-Trace-Id"] = tctx.trace
         hook = self.session.ingest_hook
         if hook is None:
-            self._reply(404, {"error": "online ingest not enabled"})
+            self._reply(404, {"error": "online ingest not enabled",
+                              "req_id": req_id}, headers=rid_hdr)
             return
         try:
             inputs = np.asarray(req.get("inputs"), dtype=np.float64)
             targets = np.asarray(req.get("targets"), dtype=np.float64)
         except (TypeError, ValueError):
             self._reply(400, {"error": "inputs/targets must be "
-                                       "numeric"})
+                                       "numeric", "req_id": req_id},
+                        headers=rid_hdr)
             return
         if inputs.ndim not in (1, 2) or targets.ndim not in (1, 2):
             self._reply(400, {"error": "inputs/targets must be "
-                                       "vectors or lists of vectors"})
+                                       "vectors or lists of vectors",
+                              "req_id": req_id}, headers=rid_hdr)
             return
         kernel = req.get("kernel")
         if kernel is not None and not isinstance(kernel, str):
-            self._reply(400, {"error": "kernel must be a string"})
+            self._reply(400, {"error": "kernel must be a string",
+                              "req_id": req_id}, headers=rid_hdr)
             return
+        sfields = {"req_id": req_id,
+                   "rows": int(np.atleast_2d(inputs).shape[0])}
+        if kernel is not None:
+            sfields["kernel"] = kernel
+        sfields.update(obs.propagate.fields(tctx))
+        span = obs.spans.start("serve.ingest", **sfields)
+        # the ingest → trainer → promote causal chain: the trainer
+        # picks this up when the buffered rows drive a round
+        obs.propagate.note("ingest", obs.propagate.ctx_from(
+            span, trace=tctx.trace if tctx is not None else None))
         try:
             out = hook(kernel, inputs, targets)
         except KeyError:
-            self._reply(404, {"error": f"unknown kernel {kernel!r}"})
+            obs.spans.finish(span, failed="KeyError")
+            self._reply(404, {"error": f"unknown kernel {kernel!r}",
+                              "req_id": req_id}, headers=rid_hdr)
         except ValueError as exc:
-            self._reply(400, {"error": str(exc)})
+            obs.spans.finish(span, failed="ValueError")
+            self._reply(400, {"error": str(exc), "req_id": req_id},
+                        headers=rid_hdr)
         else:
-            self._reply(200, out)
+            obs.spans.finish(span)
+            out = dict(out)
+            out.setdefault("req_id", req_id)
+            self._reply(200, out, headers=rid_hdr)
 
     def _reload(self, req: dict):
         name = req.get("kernel", "default")

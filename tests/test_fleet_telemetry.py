@@ -1,0 +1,400 @@
+"""Fleet telemetry plane (obs/propagate.py, obs/collector.py,
+obs/alerts.py; docs/observability.md "Fleet telemetry").
+
+Acceptance bar (ISSUE 12): a single loadgen request against a
+2-replica Router server running in ANOTHER process reconstructs —
+via ``tools/obs_report.py`` span merging — into ONE tree spanning
+both processes' sinks (client → edge/router → replica).  Plus: the
+push client sheds with counted drops and never blocks the emitting
+thread, the collector round-trips batches into ``/fleetz`` and
+``/metrics``, and the alert rule engine fires/resolves with
+cooldown, ``for=``, EWMA z-score, and malformed-term tolerance.
+"""
+
+import http.client
+import importlib
+import io
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from hpnn_tpu import obs
+from hpnn_tpu.obs import alerts, collector, propagate, spans
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _import_tool(name):
+    tools = os.path.join(ROOT, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    return importlib.import_module(name)
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _free_dead_port() -> int:
+    """A port nothing listens on (bound once, then released)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------- propagation
+def test_propagate_disabled_is_inert(tmp_path, monkeypatch):
+    monkeypatch.delenv("HPNN_SPANS", raising=False)
+    obs.configure(str(tmp_path / "a.jsonl"))
+    sp = spans.start("x")
+    assert propagate.ctx_from(sp) is None
+    headers = propagate.inject({}, None)
+    assert propagate.HDR_TRACE not in headers
+    assert propagate.extract({}) is None
+
+
+def test_propagate_inject_extract_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_SPANS", "1")
+    sink = tmp_path / "a.jsonl"
+    obs.configure(str(sink))
+    sp = spans.start("edge")
+    ctx = propagate.ctx_from(sp)
+    assert ctx is not None and ctx.trace
+    ref = propagate.ref(sp)
+    assert ref == ctx.parent and ref.startswith(f"{os.getpid():x}:")
+    headers = propagate.inject({}, ctx)
+    assert headers[propagate.HDR_TRACE] == ctx.trace
+    assert headers[propagate.HDR_PARENT] == ref
+    got = propagate.extract(headers)
+    assert got is not None
+    assert (got.trace, got.parent) == (ctx.trace, ctx.parent)
+    # span fields for the receiving side
+    f = propagate.fields(got)
+    assert f == {"trace": ctx.trace, "remote_parent": ref}
+    assert propagate.fields(None) == {}
+    # thread-slot note/peek for causal chains (ingest -> trainer)
+    propagate.note("ingest", got)
+    assert propagate.peek("ingest") is got
+    propagate.note("ingest", None)          # None never clears:
+    assert propagate.peek("ingest") is got  # latest *real* ctx wins
+    spans.finish(sp)
+    obs.flush()
+    # each adoption counts
+    assert any(r.get("ev") == "trace.adopt" for r in _read(sink))
+
+
+SERVER_SCRIPT = """\
+import sys, threading
+sys.path.insert(0, {root!r})
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.serve.server import make_server
+
+k, _ = kernel_mod.generate(7, 8, [5], 2)
+router = serve.Router(2, max_batch=8, max_wait_ms=0.5)
+router.register_kernel("k", k)
+server = make_server(router, port=0)
+print(server.server_address[1], flush=True)
+threading.Thread(target=server.serve_forever, daemon=True).start()
+sys.stdin.readline()          # parent closes stdin to stop
+server.shutdown()
+router.close()
+obs.flush()
+"""
+
+
+def test_one_request_reconstructs_across_two_process_sinks(
+        tmp_path, monkeypatch):
+    """THE cross-process proof: one loadgen request, client sink +
+    server sink, obs_report stitches ONE tree spanning both pids."""
+    sink_a = tmp_path / "client.jsonl"     # this process
+    sink_b = tmp_path / "server.jsonl"     # the server subprocess
+    script = tmp_path / "server.py"
+    script.write_text(SERVER_SCRIPT.format(root=ROOT))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", HPNN_SPANS="1",
+               HPNN_METRICS=str(sink_b))
+    proc = subprocess.Popen(
+        [sys.executable, str(script)], env=env, text=True,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
+    row = None
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.isdigit(), (
+            f"server did not start: {proc.stderr.read()[-2000:]}")
+        port = int(line)
+
+        monkeypatch.setenv("HPNN_SPANS", "1")
+        obs.configure(str(sink_a))
+        lg = _import_tool("loadgen")
+        lg._TRACE_MODS = None          # re-read the armed knob
+        cli = lg._Client(f"127.0.0.1:{port}", timeout_s=30.0)
+        body = json.dumps({"kernel": "k",
+                           "inputs": [0.1] * 8}).encode()
+        try:
+            row = cli.request("k", 1, body)
+        finally:
+            cli.close()
+            lg._TRACE_MODS = None
+        assert row["status"] == "ok" and row["req_id"]
+        assert row["trace"]            # the client minted the trace
+        obs.flush()
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=30)
+        except Exception:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    report = _import_tool("obs_report")
+    events = report.merge_events([str(sink_a), str(sink_b)])
+    all_spans = report.collect_spans(events)
+    sub = report.filter_spans_req(all_spans, row["req_id"])
+    roots = report.span_tree(sub)
+    assert len(roots) == 1, [s["name"] for s in sub]
+    root = roots[0]
+    assert root["name"] == "loadgen.request"
+    assert root["pid"] == os.getpid()
+
+    def walk(node):
+        yield node
+        for c in node["children"]:
+            yield from walk(c)
+
+    nodes = list(walk(root))
+    names = {n["name"] for n in nodes}
+    pids = {n["pid"] for n in nodes}
+    # the stitched tree crosses the process boundary and covers the
+    # whole path: client -> edge/router fan-out -> replica dispatch
+    assert len(pids) >= 2, nodes
+    assert proc.pid in pids
+    assert "router.request" in names and "serve.request" in names
+    remote = [n for n in nodes if n["pid"] == proc.pid]
+    assert all(n["fields"].get("trace") == row["trace"]
+               for n in remote if "trace" in n["fields"])
+    # rendering tags spans with their pid once >1 process contributed
+    text = report.render_spans(events, req_id=row["req_id"])
+    assert f"@{proc.pid:x}" in text
+
+
+# --------------------------------------------------------- collector
+def test_push_client_sheds_and_never_blocks(tmp_path, monkeypatch):
+    dead = _free_dead_port()
+    monkeypatch.setenv("HPNN_COLLECTOR", f"http://127.0.0.1:{dead}")
+    monkeypatch.setenv("HPNN_COLLECTOR_QUEUE", "8")
+    monkeypatch.setenv("HPNN_COLLECTOR_FLUSH_S", "60")  # no auto-drain
+    obs.configure(str(tmp_path / "s.jsonl"))
+    t0 = time.perf_counter()
+    for i in range(200):
+        obs.event("lint.burst", i=i)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0                    # O(1) offers, no I/O
+    st = collector.client_stats()
+    assert st["queued"] <= 8                # cap floor is 8
+    assert st["dropped_full"] >= 180
+    # a dead collector sheds the batch, counted, instead of retrying
+    collector.flush()
+    st = collector.client_stats()
+    assert st["dropped_push"] >= 1 and st["pushed"] == 0
+    # only the drop's own self-telemetry may trickle back in
+    assert st["queued"] <= 4
+
+
+def test_collector_roundtrip_fleetz_metrics(tmp_path):
+    out = tmp_path / "merged.jsonl"
+    server = collector.start_collector(path=str(out))
+    try:
+        port = server.server_address[1]
+        lines = [
+            json.dumps({"ts": 1.0, "ev": "serve.request",
+                        "kind": "timer", "dt": 0.004}),
+            json.dumps({"ts": 1.1, "ev": "obs.summary",
+                        "kind": "summary",
+                        "counters": {"serve.requests": 5},
+                        "gauges": {"slo.p99_ms": 4.0},
+                        "aggregates": {"serve.request": {
+                            "n": 5, "total": 0.02, "min": 0.001,
+                            "max": 0.008,
+                            "log2_buckets": {"-8": 5}}}}),
+        ]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/v1/telemetry",
+                     body=json.dumps({"pid": 4242, "rank": 0,
+                                      "lines": lines}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        doc = json.loads(resp.read().decode())
+        assert doc["ok"] is True and doc["queued"] == 2
+
+        deadline = time.monotonic() + 5.0
+        while (server.collector.records_total < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        fz = server.collector.fleetz()
+        assert fz["totals"]["records"] == 2
+        w = fz["workers"]["4242:0"]
+        assert w["records"] == 2 and w["has_summary"]
+        # merged log2 summaries give a fleet p99 per aggregate
+        assert fz["fleet"]["p99"]["serve.request"] > 0.0
+        assert fz["fleet"]["counters"]["serve.requests"] == 5
+
+        conn.request("GET", "/fleetz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(
+            resp.read().decode())["totals"]["records"] == 2
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200 and "# TYPE" in body
+        assert "hpnn_fleet_records_total 2" in body
+        assert "hpnn_fleet_workers 1" in body
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read().decode())["status"] == "ok"
+        conn.close()
+        # merged stream on disk, tagged with the sender's identity
+        recs = _read(out)
+        assert len(recs) == 2
+        assert all(r["pid"] == 4242 for r in recs)
+    finally:
+        collector.stop_collector(server)
+
+
+def test_collector_recv_queue_sheds_not_stalls():
+    c = collector.Collector(queue_max=8)
+    try:
+        c._stop.set()                       # park the consumer
+        c._consumer.join(timeout=5.0)
+        ok = sum(c.submit(1, 0, ["{}"]) for _ in range(50))
+        assert 0 < ok <= 8                  # bounded, never blocking
+        assert c.recv_dropped >= 42
+    finally:
+        c.close()
+
+
+# ------------------------------------------------------------ alerts
+def _gauge_sink(tmp_path, monkeypatch, spec):
+    monkeypatch.setenv("HPNN_ALERTS", spec)
+    sink = tmp_path / "alerts.jsonl"
+    obs.configure(str(sink))
+    return sink
+
+
+def test_alert_threshold_fires_and_resolves(tmp_path, monkeypatch):
+    sink = _gauge_sink(
+        tmp_path, monkeypatch,
+        "down@g.ready<1.5:for=0,cooldown=0,severity=crit")
+    obs.gauge("g.ready", 2.0)
+    assert alerts.health_doc()["active"] == 0
+    obs.gauge("g.ready", 1.0)
+    doc = alerts.health_doc()
+    assert doc["active"] == 1 and doc["fired_total"] == 1
+    obs.gauge("g.ready", 2.0)
+    doc = alerts.health_doc()
+    assert doc["active"] == 0 and doc["fired_total"] == 1
+    obs.flush()
+    evs = [r for r in _read(sink) if str(r.get("ev", "")).startswith(
+        "alert.")]
+    assert [r["ev"] for r in evs] == ["alert.fire", "alert.resolve"]
+    fire, resolve = evs
+    assert fire["rule"] == "down" and fire["severity"] == "crit"
+    assert fire["value"] == 1.0 and fire["threshold"] == 1.5
+    assert resolve["duration_s"] >= 0.0
+    # the stream lints clean under the --fleet schema check
+    cat = _import_tool("check_obs_catalog")
+    assert cat.lint_fleet(str(sink)) == []
+
+
+def test_alert_fire_attaches_flight_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("HPNN_FLIGHT", str(tmp_path / "flight.jsonl"))
+    sink = _gauge_sink(tmp_path, monkeypatch,
+                       "hot@g.t>10:for=0,cooldown=0")
+    obs.gauge("g.t", 99.0)
+    obs.flush()
+    fires = [r for r in _read(sink) if r.get("ev") == "alert.fire"]
+    assert fires and os.path.exists(fires[0]["flight"])
+
+
+def test_alert_cooldown_suppresses_refire(tmp_path, monkeypatch):
+    _gauge_sink(tmp_path, monkeypatch,
+                "flap@g.f>5:for=0,cooldown=3600")
+    for v in (6.0, 1.0, 7.0, 1.0, 8.0):     # three breaches, resolves
+        obs.gauge("g.f", v)
+    doc = alerts.health_doc()
+    assert doc["fired_total"] == 1          # later fires cooled down
+
+
+def test_alert_for_requires_sustained_breach(tmp_path, monkeypatch):
+    _gauge_sink(tmp_path, monkeypatch, "slow@g.s>5:for=3600")
+    obs.gauge("g.s", 10.0)
+    obs.gauge("g.s", 10.0)
+    assert alerts.health_doc()["fired_total"] == 0
+
+
+def test_alert_zscore_fires_on_anomaly(tmp_path, monkeypatch):
+    _gauge_sink(tmp_path, monkeypatch,
+                "anom@g.z:z=4,warmup=5,cooldown=0")
+    for _ in range(10):
+        obs.gauge("g.z", 10.0)              # flat warmup
+    assert alerts.health_doc()["fired_total"] == 0
+    obs.gauge("g.z", 1000.0)                # way out of band
+    assert alerts.health_doc()["fired_total"] == 1
+
+
+def test_alert_malformed_term_skipped_rest_armed(tmp_path, monkeypatch,
+                                                capsys):
+    _gauge_sink(tmp_path, monkeypatch,
+                "bad@no.operator.here, ok@g.ok>1:cooldown=0")
+    obs.gauge("g.ok", 2.0)
+    doc = alerts.health_doc()
+    assert [r["rule"] for r in doc["rules"]] == ["ok"]
+    assert doc["fired_total"] == 1
+    assert "term skipped" in capsys.readouterr().err
+
+
+# --------------------------------------------------------- obs_report
+def test_obs_report_follow_tails_a_growing_sink(tmp_path):
+    report = _import_tool("obs_report")
+    path = tmp_path / "tail.jsonl"
+
+    def writer():
+        time.sleep(0.1)                     # file appears late
+        with open(path, "w") as fp:
+            fp.write(json.dumps({"ts": 1.0, "ev": "round.start",
+                                 "kind": "event", "mode": "fused"})
+                     + "\n")
+            fp.flush()
+            time.sleep(0.1)
+            fp.write(json.dumps({"ts": 2.0, "ev": "round.end",
+                                 "kind": "event"}) + "\n")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    buf = io.StringIO()
+    n = report.follow(str(path), duration_s=0.8, out=buf, poll_s=0.02)
+    t.join()
+    text = buf.getvalue()
+    assert n == 2
+    assert "round.start" in text and "round.end" in text
+    assert "mode=fused" in text
+
+
+def test_obs_report_follow_cli_flag_validation(tmp_path):
+    report = _import_tool("obs_report")
+    # --follow wants exactly one path and no other mode
+    assert report.main(["--follow", "a.jsonl", "b.jsonl"]) == 2
+    assert report.main(["--follow", "a.jsonl", "--spans"]) == 2
+    assert report.main(["--for", "1", "a.jsonl"]) == 2

@@ -340,3 +340,93 @@ def test_main_chaos_flag_exit_codes(tmp_path, capsys):
                         "reason": "gremlins"}])
     assert mod.main(["--chaos", str(path)]) == 1
     capsys.readouterr()
+
+
+def _fleet_rows():
+    return [
+        {"ev": "trace.adopt", "kind": "count", "n": 1},
+        {"ev": "collector.listen", "kind": "event",
+         "host": "127.0.0.1", "port": 9464},
+        {"ev": "collector.push", "kind": "count", "n": 3},
+        {"ev": "collector.recv", "kind": "count", "n": 3,
+         "pid": 4242},
+        {"ev": "collector.drop", "kind": "count", "n": 1,
+         "reason": "queue_full"},
+        {"ev": "alert.fire", "rule": "down", "gauge": "router.ready",
+         "severity": "crit", "value": 0.0},
+        {"ev": "alert.resolve", "rule": "down",
+         "gauge": "router.ready", "severity": "crit", "value": 2.0,
+         "duration_s": 1.5},
+    ]
+
+
+def test_fleet_lint_accepts_a_well_formed_sink(tmp_path):
+    mod = _load()
+    path = tmp_path / "fleet.jsonl"
+    _write_sink(path, _fleet_rows())
+    assert mod.lint_fleet(str(path)) == []
+
+
+def test_fleet_lint_catches_every_schema_break(tmp_path):
+    mod = _load()
+    path = tmp_path / "fleet.jsonl"
+    breaks = [
+        ({"ev": "alert.fire", "rule": "", "gauge": "g",
+          "severity": "warn", "value": 1.0}, "non-empty string"),
+        ({"ev": "alert.fire", "rule": "r", "gauge": "g",
+          "severity": "fatal", "value": 1.0}, "info|warn|crit"),
+        ({"ev": "alert.fire", "rule": "r", "gauge": "g",
+          "severity": "info", "value": "high"},
+         "is not a finite number"),
+        ({"ev": "alert.resolve", "rule": "r", "gauge": "g",
+          "severity": "info", "value": 1.0, "duration_s": -1.0},
+         "finite non-negative"),
+        ({"ev": "alert.resolve", "rule": "r", "gauge": "g",
+          "severity": "info", "value": 1.0, "duration_s": 0.5},
+         "with no unresolved alert.fire before it"),
+        ({"ev": "collector.push", "kind": "event", "n": 1},
+         "!= 'count'"),
+        ({"ev": "trace.adopt", "kind": "count", "n": 0},
+         "positive int"),
+        ({"ev": "collector.drop", "kind": "count", "n": 1},
+         "collector.drop reason"),
+        ({"ev": "collector.recv", "kind": "count", "n": 1,
+          "pid": 3.5}, "non-negative int"),
+        ({"ev": "collector.listen", "kind": "event", "host": "",
+          "port": 9464}, "host"),
+        ({"ev": "collector.listen", "kind": "event",
+          "host": "127.0.0.1", "port": 0}, "port"),
+    ]
+    for rec, needle in breaks:
+        _write_sink(path, [rec])
+        failures = mod.lint_fleet(str(path))
+        assert failures, f"schema break not caught: {rec}"
+        assert any(needle in f for f in failures), (needle, failures)
+
+
+def test_fleet_lint_rejects_a_double_fire(tmp_path):
+    mod = _load()
+    path = tmp_path / "fleet.jsonl"
+    fire = {"ev": "alert.fire", "rule": "r", "gauge": "g",
+            "severity": "warn", "value": 9.0}
+    _write_sink(path, [fire, dict(fire)])
+    assert any("while already active" in f
+               for f in mod.lint_fleet(str(path)))
+
+
+def test_fleet_lint_fails_a_sink_with_no_fleet_records(tmp_path):
+    mod = _load()
+    path = tmp_path / "quiet.jsonl"
+    _write_sink(path, [{"ev": "obs.summary", "kind": "summary"}])
+    assert any("has no trace." in f for f in mod.lint_fleet(str(path)))
+
+
+def test_main_fleet_flag_exit_codes(tmp_path, capsys):
+    mod = _load()
+    path = tmp_path / "fleet.jsonl"
+    _write_sink(path, _fleet_rows())
+    assert mod.main(["--fleet", str(path)]) == 0
+    _write_sink(path, [{"ev": "collector.drop", "kind": "count",
+                        "n": 1}])
+    assert mod.main(["--fleet", str(path)]) == 1
+    capsys.readouterr()

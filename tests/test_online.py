@@ -511,7 +511,9 @@ def test_http_ingest_feeds_buffer_and_validates():
     try:
         code, body = _post(port, "/ingest",
                            {"inputs": [0.1] * 8, "targets": [1.0, -1.0]})
-        assert code == 200 and body == {"accepted": 1, "depth": 1}
+        assert code == 200
+        assert body["accepted"] == 1 and body["depth"] == 1
+        assert body["req_id"]       # edge-minted X-Request-Id echo
         X, T = _stream_block(4, seed=1)
         code, body = _post(port, "/v1/ingest",
                            {"kernel": "k", "inputs": X.tolist(),

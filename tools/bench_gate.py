@@ -107,6 +107,19 @@ GATE_METRICS = {
     "multiround_amortization_x": ("higher", 0.30),
     "serve_bf16_goodput_vs_f32": ("higher", 0.30),
     "serve_bf16_max_abs_err": ("lower", 1.00),
+    # fleet telemetry fold-in (bench.py bench_collector_overhead +
+    # tools/chaos_drill.py run_bench_alert_drill;
+    # docs/observability.md "Fleet telemetry"): the paired marginal
+    # cost of a live collector + firing alert rule over the
+    # sink-only round (acceptance bar <=5% — the gate guards the
+    # measured trajectory; overhead medians hover near zero so the
+    # tolerance is wide like obs_overhead_pct), time-to-fire of the
+    # replica-down alert under load, and whether the drill's alert
+    # resolved after the respawn (1.0/0.0 — any drop below a 1.0
+    # baseline fails)
+    "collector_overhead_pct": ("lower", 2.00),
+    "drill_alert_fire_s": ("lower", 1.50),
+    "drill_alert_resolved": ("higher", 0.01),
 }
 
 

@@ -35,14 +35,22 @@ catalog (docs/resilience.md):
   router's route-around is supposed to make a replica death invisible
   at the edge), and that survivors answer bitwise-identically to the
   pre-kill fleet.
+* **alert** — the telemetry plane's live proof: a
+  ``router.ready_replicas`` threshold rule (``HPNN_ALERTS``,
+  obs/alerts.py) armed over the same in-process Router, then
+  ``kill_replica(0)`` under traffic.  Asserts ``alert.fire`` lands
+  (flight-recorder dump attached) within a bounded window and that
+  ``spawn_replica()`` resolves it (``alert.resolve``).
 
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
-``drill.reload`` | ``drill.sentinel`` | ``drill.replica``;
-:func:`run_bench_drill` / :func:`run_bench_replica_drill` are the
-bench.py fold-ins (compact keys ``drill_recovery_s`` /
+``drill.reload`` | ``drill.sentinel`` | ``drill.replica`` |
+``drill.alert``; :func:`run_bench_drill` /
+:func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` are
+the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
-``drill_replica_dip_pct`` / ``drill_replica_survivors_lost``, gated
-by ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
+``drill_replica_dip_pct`` / ``drill_replica_survivors_lost`` /
+``drill_alert_fire_s`` / ``drill_alert_resolved``, gated by
+``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --drill kill9
@@ -584,11 +592,103 @@ def drill_replica(workdir: str, *, rate: float = 80.0,
         router.close()
 
 
+def drill_alert(workdir: str, *, rate: float = 60.0,
+                n_replicas: int = 2, seed: int = 4) -> dict:
+    """Prove the alert plane live: a threshold rule on the router's
+    ``router.ready_replicas`` gauge (obs/alerts.py), loadgen flowing,
+    then ``kill_replica(0)``.  The gauge re-emits on the kill, the
+    rule breaches, ``alert.fire`` lands with the flight-recorder dump
+    attached; ``spawn_replica()`` re-emits a healthy value and the
+    rule resolves.  Asserts the fire/resolve pair is in the sink, the
+    dump file exists, and both transitions happened within a bounded
+    window (``drill_alert_fire_s`` / ``drill_alert_resolved`` in
+    bench_gate.py)."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve import make_server
+    from hpnn_tpu.serve.router import Router
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.alert", "ok": False,
+                 "replicas": n_replicas, "killed_rank": 0}
+    sink = os.path.join(workdir, "alert-drill.metrics.jsonl")
+    flight_path = os.path.join(workdir, "alert-flight.jsonl")
+    env_keys = ("HPNN_ALERTS", "HPNN_FLIGHT", "HPNN_METRICS")
+    prev_env = {key: os.environ.get(key) for key in env_keys}
+    os.environ["HPNN_ALERTS"] = (
+        f"replicas_down@router.ready_replicas<{n_replicas - 0.5}:"
+        "for=0,cooldown=0,severity=crit")
+    os.environ["HPNN_FLIGHT"] = flight_path
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    router = server = None
+    try:
+        obs.configure(sink)   # re-reads every knob, arms rule + ring
+        router = Router(n_replicas, max_batch=16, max_wait_ms=0.5)
+        router.register_kernel(KERNEL, k)
+        server = make_server(router)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        load = _Load(port, rate=rate, ingest_frac=0.0, seed=seed)
+        time.sleep(1.5)           # baseline bins under healthy fleet
+        t_kill = load.now()
+        router.kill_replica(0)    # gauge drops below the bound
+        fired = _wait(lambda: (obs.alerts.health_doc().get("active")
+                               or None), 5.0, interval_s=0.02)
+        t_fire = load.now()
+        router.spawn_replica()    # gauge back to healthy
+        resolved = _wait(
+            lambda: (obs.alerts.health_doc().get("active") == 0
+                     or None), 5.0, interval_s=0.02)
+        t_resolve = load.now()
+        records = load.finish(settle_s=0.5)
+        census = obs.alerts.health_doc()
+        # close the sink so the audit reads a complete stream
+        obs.configure(None)
+        events = []
+        with open(sink) as fp:
+            for line in fp:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        fires = [r for r in events if r.get("ev") == "alert.fire"
+                 and r.get("rule") == "replicas_down"]
+        resolves = [r for r in events if r.get("ev") == "alert.resolve"
+                    and r.get("rule") == "replicas_down"]
+        out.update(blast_radius(records, t_kill))
+        out["fire_s"] = round(t_fire - t_kill, 3) if fired else None
+        out["resolve_s"] = (round(t_resolve - t_kill, 3)
+                            if resolved else None)
+        out["resolved"] = bool(resolved and resolves)
+        out["fired_total"] = census.get("fired_total", 0)
+        out["flight_attached"] = bool(
+            fires and fires[-1].get("flight")
+            and os.path.exists(fires[-1]["flight"]))
+        out["ok"] = bool(fired and fires
+                         and out["resolved"]
+                         and out["flight_attached"])
+        return out
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if router is not None:
+            router.close()
+        obs.configure(None)
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
     "sentinel": drill_sentinel,
     "replica": drill_replica,
+    "alert": drill_alert,
 }
 
 
@@ -627,6 +727,30 @@ def run_bench_drill(*, rate: float = 40.0) -> dict:
     return out
 
 
+def run_bench_alert_drill(*, rate: float = 60.0,
+                          n_replicas: int = 2) -> dict:
+    """The bench.py fold-in for the alert drill: kill + respawn one
+    of N replicas under load with a ``router.ready_replicas``
+    threshold rule armed, and report fire/resolve latency as
+    gateable numbers (``drill_alert_fire_s`` /
+    ``drill_alert_resolved``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_alert(tmp, rate=rate, n_replicas=n_replicas)
+    out = {
+        "metric": "alert_drill",
+        "drill": row,
+        "fire_s": row.get("fire_s"),
+        "resolve_s": row.get("resolve_s"),
+        "resolved": 1.0 if row.get("resolved") else 0.0,
+        "flight_attached": row.get("flight_attached"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 def run_bench_replica_drill(*, rate: float = 80.0,
                             n_replicas: int = 3) -> dict:
     """The bench.py fold-in for the replica drill: kill 1 of N under
@@ -656,10 +780,10 @@ def run_bench_replica_drill(*, rate: float = 80.0,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
-                    "(kill9 / reload / sentinel / replica)")
+                    "(kill9 / reload / sentinel / replica / alert)")
     ap.add_argument("--drill", default="all",
                     choices=("all", "kill9", "reload", "sentinel",
-                             "replica"))
+                             "replica", "alert"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

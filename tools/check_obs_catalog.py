@@ -76,11 +76,21 @@ without a rank, a shed-around without a reason, or an outstanding
 gauge that can go negative makes a capacity incident unattributable,
 so their shapes are frozen too.
 
+And the fleet-telemetry schema lint (:func:`lint_fleet`): the
+``trace.adopt`` counts (obs/propagate.py), the ``collector.*``
+push/drop/recv accounting (obs/collector.py) and the ``alert.fire`` /
+``alert.resolve`` events (obs/alerts.py, HPNN_ALERTS) are how an
+operator reconstructs a fleet incident — an alert that double-fires
+or resolves thin air, a shed without a reason, or a worker record
+without a finite pid makes the telemetry plane itself untrustworthy,
+so their shapes (and the per-rule fire/resolve pairing) are frozen
+too (docs/observability.md "Fleet telemetry").
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
-        [--serve-replicas PATH]
+        [--serve-replicas PATH] [--fleet PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -1155,6 +1165,137 @@ def lint_serve_replicas(path: str) -> list[str]:
     return failures
 
 
+def lint_fleet(path: str) -> list[str]:
+    """Schema-lint the fleet-telemetry records of one metrics sink
+    (trace propagation, collector traffic, alerting —
+    docs/observability.md "Fleet telemetry").
+
+    Checks, per record:
+
+    * ``alert.fire`` / ``alert.resolve`` events — non-empty ``rule``
+      and ``gauge``; ``severity`` in info|warn|crit; finite numeric
+      ``value``; ``alert.resolve`` additionally a finite
+      ``duration_s`` >= 0.  Per rule, the stream must PAIR: a resolve
+      with no prior unresolved fire, or two fires with no resolve
+      between them, fails (an alert plane that can double-fire or
+      resolve thin air is un-auditable).
+    * ``collector.push`` / ``collector.drop`` / ``collector.recv``
+      counts — ``kind == "count"``, positive int ``n``;
+      ``collector.drop`` a non-empty ``reason`` (queue_full |
+      push_error | recv_queue_full — a shed that can't say why is
+      undebuggable); ``collector.recv`` a non-negative int ``pid``
+      (worker identity must be finite, never a float or null).
+    * ``collector.listen`` events — non-empty ``host``, ``port`` an
+      int in [1, 65535].
+    * ``trace.adopt`` counts — ``kind == "count"``, positive int
+      ``n``.
+
+    A sink with no ``trace.*`` / ``collector.*`` / ``alert.*``
+    records fails — this lint only makes sense on a run with the
+    telemetry plane armed.  Returns failure strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+
+    n_fleet = 0
+    active: dict[str, int] = {}   # rule -> unresolved fire count
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if isinstance(ev, str) and ev.startswith(("trace.",
+                                                  "collector.",
+                                                  "alert.")):
+            n_fleet += 1
+        if ev in ("alert.fire", "alert.resolve"):
+            for key in ("rule", "gauge"):
+                v = rec.get(key)
+                if not isinstance(v, str) or not v:
+                    failures.append(
+                        f"{at}: {ev} {key} {v!r} is not a non-empty "
+                        "string")
+            sev = rec.get("severity")
+            if sev not in ("info", "warn", "crit"):
+                failures.append(
+                    f"{at}: {ev} severity {sev!r} is not "
+                    "info|warn|crit")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v):
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a finite number")
+            rule = rec.get("rule")
+            if ev == "alert.fire":
+                if isinstance(rule, str) and active.get(rule, 0) > 0:
+                    failures.append(
+                        f"{at}: alert.fire for rule {rule!r} while "
+                        "already active (no resolve in between)")
+                if isinstance(rule, str):
+                    active[rule] = active.get(rule, 0) + 1
+            else:
+                d = rec.get("duration_s")
+                if not _num(d) or not math.isfinite(d) or d < 0:
+                    failures.append(
+                        f"{at}: alert.resolve duration_s {d!r} is "
+                        "not a finite non-negative number")
+                if isinstance(rule, str):
+                    if active.get(rule, 0) < 1:
+                        failures.append(
+                            f"{at}: alert.resolve for rule {rule!r} "
+                            "with no unresolved alert.fire before it")
+                    else:
+                        active[rule] -= 1
+        elif ev in ("collector.push", "collector.drop",
+                    "collector.recv", "trace.adopt"):
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'count'")
+            if not _pos_int(rec.get("n")):
+                failures.append(
+                    f"{at}: {ev} increment {rec.get('n')!r} is not a "
+                    "positive int")
+            if ev == "collector.drop":
+                r = rec.get("reason")
+                if not isinstance(r, str) or not r:
+                    failures.append(
+                        f"{at}: collector.drop reason {r!r} is not a "
+                        "non-empty string")
+            if ev == "collector.recv":
+                pid = rec.get("pid")
+                if (not isinstance(pid, int) or isinstance(pid, bool)
+                        or pid < 0):
+                    failures.append(
+                        f"{at}: collector.recv pid {pid!r} is not a "
+                        "non-negative int")
+        elif ev == "collector.listen":
+            h = rec.get("host")
+            if not isinstance(h, str) or not h:
+                failures.append(
+                    f"{at}: collector.listen host {h!r} is not a "
+                    "non-empty string")
+            p = rec.get("port")
+            if (not isinstance(p, int) or isinstance(p, bool)
+                    or not 1 <= p <= 65535):
+                failures.append(
+                    f"{at}: collector.listen port {p!r} is not an "
+                    "int in [1, 65535]")
+    if not n_fleet:
+        failures.append(
+            f"sink {path!r} has no trace.* / collector.* / alert.* "
+            "records — was the telemetry plane armed?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1205,6 +1346,13 @@ def main(argv: list[str] | None = None) -> int:
                              "needs a path\n")
             return 2
         failures += lint_serve_replicas(argv[i + 1])
+    if "--fleet" in argv:
+        i = argv.index("--fleet")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --fleet needs a "
+                             "path\n")
+            return 2
+        failures += lint_fleet(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

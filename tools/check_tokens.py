@@ -30,11 +30,17 @@ persistent compile cache armed, ``HPNN_COMPILE_CACHE_DIR``), the whole
 additionally exercised to silence below), the chaos + durability
 knobs (``HPNN_CHAOS`` / ``HPNN_CHAOS_SEED`` / ``HPNN_WAL_DIR``,
 docs/resilience.md — the train path carries no injection seams and
-never touches the WAL, so an armed plan must stay inert here), and a
+never touches the WAL, so an armed plan must stay inert here), the
+fleet telemetry plane (``HPNN_COLLECTOR`` pointed at a LIVE
+in-process collector on an ephemeral port, plus an ``HPNN_ALERTS``
+rule that actually fires on the round's own ``fuse.chunk_size``
+gauge — docs/observability.md "Fleet telemetry"), and a
 live export server whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
-minimal one.  A final ledger-only run proves the probes are
+minimal one.  The collector must come out the other end having
+actually received the pushed records — silence alone would also be
+the signature of a dead push path.  A final ledger-only run proves the probes are
 zero-perturbation: its checksum ledger must equal the probed run's
 row for row (equal abs-sums on the f64 CPU parity path mean equal
 weights — enabling probes did not move the trajectory).
@@ -55,6 +61,7 @@ import os
 import re
 import sys
 import tempfile
+import time
 
 TOKEN_PREFIXES = ("NN: ", "NN(WARN): ", "NN(ERR): ", "NN(DBG): ",
                   "#DBG: acc[")
@@ -184,6 +191,18 @@ def check(tmpdir: str) -> list[str]:
     from hpnn_tpu import chaos as chaos_mod
     from hpnn_tpu.online import wal as wal_mod
 
+    # the fleet telemetry plane rides the same proof, LIVE: a real
+    # collector on an ephemeral port with the push client armed at an
+    # aggressive flush cadence, plus an alert rule that actually fires
+    # on the round's own fuse.chunk_size gauge (flight dump attached) —
+    # none of it may move stdout by a byte, and the collector must
+    # come out the other end having actually received the records
+    from hpnn_tpu.obs import collector as collector_mod
+
+    coll_out = os.path.join(tmpdir, "collector_merged.jsonl")
+    coll_server = collector_mod.start_collector(path=coll_out)
+    coll_port = coll_server.server_address[1]
+
     wal_dir = os.path.join(tmpdir, "wal")
     ledger_b = os.path.join(tmpdir, "ledger_b.jsonl")
     os.environ["HPNN_FLIGHT"] = os.path.join(tmpdir, "flight.jsonl")
@@ -196,6 +215,9 @@ def check(tmpdir: str) -> list[str]:
     os.environ["HPNN_CHAOS"] = "delay@serve.dispatch:ms=0"
     os.environ["HPNN_CHAOS_SEED"] = "1"
     os.environ["HPNN_WAL_DIR"] = wal_dir
+    os.environ["HPNN_COLLECTOR"] = f"http://127.0.0.1:{coll_port}"
+    os.environ["HPNN_COLLECTOR_FLUSH_S"] = "0.05"
+    os.environ["HPNN_ALERTS"] = "lint_chunk@fuse.chunk_size>0:cooldown=0"
     for knob, val in _ONLINE_KNOBS:
         os.environ[knob] = val
     chaos_mod._reset_for_tests()
@@ -207,7 +229,9 @@ def check(tmpdir: str) -> list[str]:
         for knob in ("HPNN_FLIGHT", "HPNN_PROBES", "HPNN_NUMERICS",
                      "HPNN_LEDGER", "HPNN_SPANS", "HPNN_COST",
                      "HPNN_SLO_MS", "HPNN_CHAOS", "HPNN_CHAOS_SEED",
-                     "HPNN_WAL_DIR") + tuple(k for k, _ in _ONLINE_KNOBS):
+                     "HPNN_WAL_DIR", "HPNN_COLLECTOR",
+                     "HPNN_COLLECTOR_FLUSH_S",
+                     "HPNN_ALERTS") + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
         chaos_mod._reset_for_tests()
         wal_mod._reset_for_tests()
@@ -217,13 +241,31 @@ def check(tmpdir: str) -> list[str]:
             "stdout is NOT byte-identical with HPNN_METRICS + "
             "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
             "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + HPNN_CHAOS + "
-            "HPNN_WAL_DIR + HPNN_ONLINE_* (incl. HPNN_ONLINE_SCAN_K) + "
+            "HPNN_WAL_DIR + HPNN_COLLECTOR (live push) + HPNN_ALERTS "
+            "(firing rule) + HPNN_ONLINE_* (incl. HPNN_ONLINE_SCAN_K) + "
             "HPNN_SERVE_DTYPE=bf16 + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
     if os.path.exists(os.path.join(wal_dir, wal_mod.WAL_NAME)):
         failures.append(
             "a plain train round wrote the promotion WAL — "
             "HPNN_WAL_DIR must be inert outside hpnn_tpu/online/")
+    # the push client's final drain ran inside _run_round's
+    # obs.configure(None); give the collector's consumer thread a beat
+    # to absorb the last batch, then the received count must be real
+    coll = coll_server.collector
+    deadline = time.monotonic() + 5.0
+    while coll.records_total == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    fleet_doc = coll.fleetz()
+    collector_mod.stop_collector(coll_server)
+    if coll.records_total <= 0:
+        failures.append(
+            "live collector received NO telemetry with HPNN_COLLECTOR "
+            "armed — the push path is dead")
+    elif not fleet_doc.get("workers"):
+        failures.append(
+            "collector /fleetz lists no workers after a pushed round "
+            f"(records_total={coll.records_total})")
     body = scraped.get("metrics", "")
     if "# TYPE" not in body or "hpnn_" not in body:
         failures.append(
@@ -461,7 +503,7 @@ def check(tmpdir: str) -> list[str]:
                  "fuse.chunk_size", "round.end", "obs.summary",
                  "device.live_arrays", "numerics.probe",
                  "numerics.checksum", "span.end", "compile.cost",
-                 "perf.flops_per_s"):
+                 "perf.flops_per_s", "alert.fire", "collector.push"):
         if want not in names:
             failures.append(f"metrics sink missing event {want!r}")
     return failures

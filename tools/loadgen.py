@@ -30,7 +30,13 @@ failures (refused, reset, incomplete response) are a distinct
 ``tools/chaos_drill.py`` gate on (docs/resilience.md).  The server's
 ``X-Request-Id`` is recorded per outcome, so any row in the JSONL
 (``--out``) cross-correlates with the span sink via
-``tools/obs_report.py --spans --req <id>``.
+``tools/obs_report.py --spans --req <id>``.  With spans armed
+(``HPNN_SPANS``) each request additionally opens a client-side
+``loadgen.request`` span and carries its trace context in
+``X-Trace-Id`` / ``X-Parent-Span`` headers (obs/propagate.py), so the
+server-side spans parent across the process boundary and the report
+stitches one client → edge → replica tree per request
+(docs/observability.md "Fleet telemetry").
 
 Outcome rows: ``{"t", "kernel", "rows", "status": ok|shed|timeout|
 error|lost, "code", "latency_ms", "req_id", "attempts"}``; the summary
@@ -198,6 +204,33 @@ def make_arrivals(process: str, rate_rps: float, duration_s: float,
 # ------------------------------------------------------------ client
 
 
+# Lazy handle on the obs propagation modules: None = not probed yet,
+# False = spans disarmed (or package unavailable) — probed once, so
+# the common un-instrumented run never pays a per-request check.
+_TRACE_MODS = None
+
+
+def _trace_mods():
+    """(propagate, spans) when ``HPNN_SPANS`` is armed, else None."""
+    global _TRACE_MODS
+    if _TRACE_MODS is None:
+        _TRACE_MODS = False
+        try:
+            from hpnn_tpu.obs import propagate, spans
+        except ImportError:
+            root = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            if root not in sys.path:
+                sys.path.insert(0, root)
+            try:
+                from hpnn_tpu.obs import propagate, spans
+            except ImportError:
+                return None
+        if spans.enabled():
+            _TRACE_MODS = (propagate, spans)
+    return _TRACE_MODS or None
+
+
 class _Client:
     """One keep-alive HTTP connection with reconnect-on-disconnect
     and the per-request retry policy (429 + ``Retry-After``)."""
@@ -217,7 +250,10 @@ class _Client:
             finally:
                 self._conn = None
 
-    def _post(self, path: str, body: bytes):
+    def _post(self, path: str, body: bytes, headers: dict | None = None):
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         # one silent reconnect: a keep-alive peer may have gone away
         for attempt in (0, 1):
             try:
@@ -232,8 +268,7 @@ class _Client:
                     self._conn.sock.setsockopt(
                         socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._conn.request(
-                    "POST", path, body=body,
-                    headers={"Content-Type": "application/json"})
+                    "POST", path, body=body, headers=hdrs)
                 resp = self._conn.getresponse()
                 data = resp.read()
                 return resp.status, dict(resp.getheaders()), data
@@ -261,11 +296,22 @@ class _Client:
         ``lost`` (nothing answered — the blast-radius class the chaos
         drills count), other codes -> ``error``."""
         attempts, code, req_id, status = 0, None, None, "error"
+        span, hdrs, trace = None, None, None
+        mods = _trace_mods()
+        if mods is not None:
+            propagate, spans = mods
+            span = spans.start("loadgen.request", kernel=kernel,
+                               rows=rows, op=op)
+            ctx = propagate.ctx_from(span)
+            if ctx is not None:
+                trace = ctx.trace
+                hdrs = propagate.inject({}, ctx)
         t_start = time.perf_counter()
         while True:
             attempts += 1
             try:
-                code, headers, _data = self._post(path, body)
+                code, headers, _data = self._post(path, body,
+                                                  headers=hdrs)
             except socket.timeout:
                 status, code = "timeout", None
                 break
@@ -292,7 +338,12 @@ class _Client:
                 continue
             status = "timeout" if code == 504 else "error"
             break
-        return {
+        if span is not None:
+            done = {"status": status}
+            if req_id is not None:
+                done["req_id"] = req_id
+            mods[1].finish(span, **done)
+        rec = {
             "kernel": kernel,
             "rows": rows,
             "op": op,
@@ -303,6 +354,9 @@ class _Client:
             "req_id": req_id,
             "attempts": attempts,
         }
+        if trace is not None:
+            rec["trace"] = trace
+        return rec
 
 
 def _request_bodies(kernels, rows_choices, n_in: int,

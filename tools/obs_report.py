@@ -8,6 +8,8 @@ Usage::
     python tools/obs_report.py run.metrics.jsonl --spans  # span tree
     python tools/obs_report.py --merge run.0.jsonl run.1.jsonl \
         [--out merged.jsonl]                              # cross-rank
+    python tools/obs_report.py run.metrics.jsonl --follow [--for S]
+                                                          # live tail
 
 Reads the event stream produced by ``hpnn_tpu.obs`` (schema:
 docs/observability.md) and prints, in order: the run header, lifecycle
@@ -23,6 +25,14 @@ one rank's own emission order), and the streams are stably merged by
 ``(ts, rank, seq)`` — skew between hosts cannot interleave a rank
 against itself, only shift it against its peers.
 
+Span ids are process-local, so the span report keys every span by a
+global ``"<pid-hex>:<id>"`` ref (pid from the record's own tag or the
+stream's ``obs.open`` line) and resolves ``remote_parent`` fields
+(obs/propagate.py) across processes: feed ``--merge --spans --req
+<id>`` the sinks of a client, an edge and its replicas, and ONE
+request renders as one tree spanning all of them (docs/observability.md
+"Fleet telemetry").  ``--follow`` live-tails a growing sink.
+
 stdlib-only on purpose: the report must render on a login node with no
 jax installed, and ``bench.py`` imports :func:`summarize` in-process.
 """
@@ -32,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 # kinds whose per-line records we keep verbatim for the ordered logs
 _FALLBACK_EVS = (
@@ -71,10 +82,13 @@ def merge_events(paths: list[str]) -> list[dict]:
     tagged = []
     for pos, path in enumerate(paths):
         events = load_events(path)
-        rank = pos
+        rank, pid = pos, None
         for rec in events:
-            if rec.get("ev") == "obs.open" and "rank" in rec:
-                rank = int(rec["rank"])
+            if rec.get("ev") == "obs.open":
+                if "rank" in rec:
+                    rank = int(rec["rank"])
+                if "pid" in rec:
+                    pid = int(rec["pid"])
                 break
         last_ts = 0.0
         for seq, rec in enumerate(events):
@@ -84,9 +98,69 @@ def merge_events(paths: list[str]) -> list[dict]:
             last_ts = ts
             rec = dict(rec)
             rec.setdefault("rank", rank)
+            if pid is not None:
+                rec.setdefault("pid", pid)
             tagged.append((ts, rank, seq, rec))
     tagged.sort(key=lambda t: t[:3])
     return [rec for _ts, _rank, _seq, rec in tagged]
+
+
+def _follow_line(rec: dict) -> str:
+    """One compact human line per live-tailed record."""
+    ts = rec.get("ts")
+    head = f"{ts:12.3f}" if isinstance(ts, (int, float)) else " " * 12
+    ev = rec.get("ev", "?")
+    fields = ", ".join(
+        f"{k}={v}" for k, v in rec.items()
+        if k not in ("ts", "ev", "kind") and not isinstance(v, (dict,
+                                                                list)))
+    return f"{head}  {ev:<28s}" + (f" {fields}" if fields else "")
+
+
+def follow(path: str, duration_s: float | None = None,
+           out=None, poll_s: float = 0.25) -> int:
+    """Live-tail a growing JSONL sink (``--follow``): print one
+    compact line per record as it lands, from the start of the file.
+    A not-yet-created file is waited for; a torn tail line is held
+    back until its newline arrives (the crash-safe writer appends
+    whole lines, so a partial read is mid-write, not corruption).
+    Runs until ``duration_s`` elapses (forever when None — ^C stops
+    it); returns the number of records printed."""
+    out = out or sys.stdout
+    t0 = time.monotonic()
+    fp, buf, n = None, "", 0
+    try:
+        while True:
+            if fp is None:
+                try:
+                    fp = open(path)
+                except OSError:
+                    pass
+            if fp is not None:
+                chunk = fp.read()
+                if chunk:
+                    buf += chunk
+                    while "\n" in buf:
+                        line, buf = buf.split("\n", 1)
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        out.write(_follow_line(rec) + "\n")
+                        out.flush()
+                        n += 1
+            if (duration_s is not None
+                    and time.monotonic() - t0 >= duration_s):
+                return n
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        return n
+    finally:
+        if fp is not None:
+            fp.close()
 
 
 def _merge_hist(dst: dict, rec: dict) -> None:
@@ -170,23 +244,55 @@ def summarize(events: list[dict]) -> dict:
     return rep
 
 
-_SPAN_META = ("ts", "ev", "kind", "span", "parent", "name", "t0", "dt")
+_SPAN_META = ("ts", "ev", "kind", "span", "parent", "name", "t0", "dt",
+              "remote_parent", "pid", "rank")
 
 
 def collect_spans(events: list[dict]) -> list[dict]:
     """Pull the ``span.end`` records (HPNN_SPANS) out of the stream.
 
-    Each span carries its own id, its parent id (or None for a root),
-    a monotonic start ``t0`` and duration ``dt``.  Returned in ``t0``
-    order so the tree renders in wall-clock order.
+    Each span carries its own local id, its local parent id (or None),
+    a monotonic start ``t0`` and duration ``dt``.  Ids are only unique
+    *within* one process, so every span also gains a globally-unique
+    ``ref`` = ``"<pid-hex>:<id>"`` — the pid comes from the record
+    itself (collector-merged streams tag each record), from the
+    stream's ``obs.open`` line, or defaults to 0 for a legacy
+    single-process sink.  ``parent_ref`` resolves in that key space:
+    a local parent id stays within the span's own process, while a
+    ``remote_parent`` field (obs/propagate.py — a ref minted in
+    ANOTHER process and carried over the wire in ``X-Parent-Span``)
+    crosses it, which is what lets one request's tree span N sinks.
+    Returned in ``t0`` order (meaningful within a process; across
+    processes it is only a display order).
     """
+    # pre-pass: last obs.open pid per rank, for streams whose records
+    # are not individually pid-tagged
+    pid_of_rank: dict = {}
+    default_pid = 0
+    for rec in events:
+        if rec.get("ev") == "obs.open" and "pid" in rec:
+            default_pid = int(rec["pid"])
+            pid_of_rank[rec.get("rank")] = default_pid
     spans = []
     for rec in events:
         if rec.get("ev") != "span.end":
             continue
+        pid = rec.get("pid")
+        if pid is None:
+            pid = pid_of_rank.get(rec.get("rank"), default_pid)
+        pid = int(pid)
+        sid = rec.get("span")
+        parent = rec.get("parent")
+        if parent is not None:
+            parent_ref = f"{pid:x}:{parent}"
+        else:
+            parent_ref = rec.get("remote_parent")
         spans.append({
-            "span": rec.get("span"),
-            "parent": rec.get("parent"),
+            "span": sid,
+            "parent": parent,
+            "pid": pid,
+            "ref": None if sid is None else f"{pid:x}:{sid}",
+            "parent_ref": parent_ref,
             "name": rec.get("name", "?"),
             "t0": float(rec.get("t0", 0.0)),
             "dt": float(rec.get("dt", 0.0)),
@@ -202,44 +308,50 @@ def filter_spans_req(spans: list[dict], req_id: str) -> list[dict]:
     fields carry ``req_id == <id>`` (the edge-minted id the HTTP layer
     threads through ``serve.request``/``serve.queue``), plus their
     ancestors and descendants — so ``--req`` reconstructs the full
-    queue/dispatch breakdown of a single request from a busy sink."""
-    by_id = {s["span"]: s for s in spans if s["span"] is not None}
+    queue/dispatch breakdown of a single request from a busy sink.
+    Ancestry follows ``parent_ref``, so with trace propagation armed
+    the kept set crosses process boundaries (client → edge → replica).
+    """
+    by_id = {s["ref"]: s for s in spans if s["ref"] is not None}
     keep: set = set()
     for s in spans:
         if s["fields"].get("req_id") != req_id:
             continue
         cur = s
-        while cur is not None and cur["span"] not in keep:
-            keep.add(cur["span"])
-            cur = by_id.get(cur["parent"])
+        while cur is not None and cur["ref"] not in keep:
+            keep.add(cur["ref"])
+            cur = by_id.get(cur["parent_ref"])
     changed = True
     while changed:
         changed = False
         for s in spans:
-            if s["span"] in keep:
+            if s["ref"] in keep:
                 continue
-            parent = by_id.get(s["parent"])
-            if parent is not None and parent["span"] in keep:
-                keep.add(s["span"])
+            parent = by_id.get(s["parent_ref"])
+            if parent is not None and parent["ref"] in keep:
+                keep.add(s["ref"])
                 changed = True
-    return [s for s in spans if s["span"] in keep]
+    return [s for s in spans if s["ref"] in keep]
 
 
 def span_tree(spans: list[dict]) -> list[dict]:
     """Arrange spans into root trees (children nested under parents).
 
-    A span whose parent id never finished (e.g. a truncated sink) is
-    promoted to a root rather than dropped.  Children stay in ``t0``
-    order.  Returns the list of roots; each node gains ``children``
-    and ``child_s`` (the sum of its direct children's durations — by
-    construction ≤ the parent's own ``dt`` when nesting is honest,
-    which is what the report lets you eyeball).
+    Parent links resolve by global ``ref``, so a remote parent (trace
+    propagation) nests its children exactly like a local one.  A span
+    whose parent never finished in any provided sink (e.g. a truncated
+    or missing file) is promoted to a root rather than dropped.
+    Children stay in ``t0`` order.  Returns the list of roots; each
+    node gains ``children`` and ``child_s`` (the sum of its direct
+    children's durations — by construction ≤ the parent's own ``dt``
+    when nesting is honest AND the child ran in the parent's process;
+    a remote child's clock is its own).
     """
-    by_id = {s["span"]: s for s in spans if s["span"] is not None}
+    by_id = {s["ref"]: s for s in spans if s["ref"] is not None}
     roots: list[dict] = []
     for s in spans:
         s.setdefault("children", [])
-        parent = by_id.get(s["parent"])
+        parent = by_id.get(s["parent_ref"])
         if parent is None or parent is s:
             roots.append(s)
         else:
@@ -249,19 +361,21 @@ def span_tree(spans: list[dict]) -> list[dict]:
     return roots
 
 
-def _render_span_node(w, node: dict, depth: int) -> None:
+def _render_span_node(w, node: dict, depth: int,
+                      show_pid: bool = False) -> None:
     pad = "  " * depth
     extra = ""
     if node["children"]:
         extra = (f"  (children {node['child_s']:.6f}s,"
                  f" self {max(node['dt'] - node['child_s'], 0.0):.6f}s)")
+    tag = f" @{node['pid']:x}" if show_pid else ""
     fields = ", ".join(f"{k}={v}" for k, v in
                        sorted(node["fields"].items()))
     w(f"  {pad}{node['name']:<{max(28 - 2 * depth, 8)}s}"
-      f" {node['dt']:10.6f}s{extra}"
+      f" {node['dt']:10.6f}s{tag}{extra}"
       + (f"  [{fields}]" if fields else ""))
     for child in node["children"]:
-        _render_span_node(w, child, depth + 1)
+        _render_span_node(w, child, depth + 1, show_pid)
 
 
 def render_spans(events: list[dict], top: int = 10,
@@ -289,19 +403,23 @@ def render_spans(events: list[dict], top: int = 10,
             w("  (no span.end records — was HPNN_SPANS set?)")
         return "\n".join(out) + "\n"
     w(f"spans: {len(spans)}")
+    pids = sorted({s["pid"] for s in spans})
+    multi = len(pids) > 1
+    if multi:
+        w("processes: " + ", ".join(f"{p:x}" for p in pids))
     w("")
     w("-- latency tree (t0 order; dt seconds) --")
     for root in span_tree(spans):
-        _render_span_node(w, root, 0)
+        _render_span_node(w, root, 0, show_pid=multi)
     w("")
     w(f"-- slowest {min(top, len(spans))} --")
-    w(f"  {'name':28s} {'dt_s':>10s} {'span':>6s} {'parent':>6s}")
+    w(f"  {'name':28s} {'dt_s':>10s} {'span':>12s} {'parent':>12s}")
     for s in sorted(spans, key=lambda s: -s["dt"])[:top]:
-        parent = "-" if s["parent"] is None else str(s["parent"])
+        parent = s["parent_ref"] or "-"
         flag = (f"  FAILED({s['fields']['failed']})"
                 if s["fields"].get("failed") else "")
-        w(f"  {s['name']:28s} {s['dt']:10.6f} {str(s['span']):>6s}"
-          f" {parent:>6s}{flag}")
+        w(f"  {s['name']:28s} {s['dt']:10.6f} {str(s['ref']):>12s}"
+          f" {parent:>12s}{flag}")
     return "\n".join(out) + "\n"
 
 
@@ -432,7 +550,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--out", metavar="FILE",
                     help="with --merge: also write the merged JSONL "
                          "timeline to FILE")
+    ap.add_argument("--follow", action="store_true",
+                    help="live-tail ONE growing sink: one compact "
+                         "line per record as it lands (^C stops)")
+    ap.add_argument("--for", dest="follow_s", type=float, metavar="S",
+                    help="with --follow: stop after S seconds "
+                         "(default: run until interrupted)")
     args = ap.parse_args(argv)
+    if args.follow:
+        if (args.merge or args.spans or args.json
+                or len(args.paths) > 1):
+            sys.stderr.write("obs_report: --follow takes one path and "
+                             "no other mode\n")
+            return 2
+        follow(args.paths[0], duration_s=args.follow_s)
+        return 0
+    if args.follow_s is not None:
+        sys.stderr.write("obs_report: --for needs --follow\n")
+        return 2
     if len(args.paths) > 1 and not args.merge:
         sys.stderr.write("obs_report: several paths need --merge\n")
         return 2
