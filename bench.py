@@ -1170,6 +1170,38 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["alert_drill_error"] = repr(exc)
 
+    # Worker chaos drill (tools/chaos_drill.py run_bench_worker_drill):
+    # SIGKILL one of two WorkerSupervisor-managed worker PROCESSES
+    # behind the ClusterRouter under load — bounded dip, zero survivor
+    # losses, bitwise survivor answers, and a bounded replacement
+    # latency (docs/resilience.md).  Rides the same HPNN_BENCH_NO_DRILL
+    # knob (spawns subprocesses, ~15 s).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["worker_drill"] = chaos_drill.run_bench_worker_drill()
+        except Exception as exc:
+            out["worker_drill_error"] = repr(exc)
+
+    # Autoscale ramp (tools/bench_autoscale.py): a loadgen ramp past
+    # the single-worker plateau that the SLO-driven autoscaler rides —
+    # width 1→N under overdrive, windowed goodput vs the plateau,
+    # bounded p99, width back to 1 after the ramp (docs/serving.md
+    # "Cross-host fleet").  HPNN_BENCH_NO_AUTOSCALE=1 skips it (spawns
+    # worker subprocesses, ~30 s).
+    if not os.environ.get("HPNN_BENCH_NO_AUTOSCALE"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import bench_autoscale
+
+            out["autoscale"] = bench_autoscale.run_bench_autoscale()
+        except Exception as exc:
+            out["autoscale_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -1277,6 +1309,18 @@ def main(argv=None) -> None:
         ad = out["alert_drill"]
         compact["drill_alert_fire_s"] = ad["fire_s"]
         compact["drill_alert_resolved"] = ad["resolved"]
+    if ("worker_drill" in out
+            and out["worker_drill"].get("replaced_s") is not None):
+        wd = out["worker_drill"]
+        compact["drill_worker_dip_pct"] = wd["goodput_dip_pct"]
+        compact["drill_worker_replaced_s"] = wd["replaced_s"]
+    if ("autoscale" in out
+            and out["autoscale"].get("goodput_x") is not None):
+        asc = out["autoscale"]
+        compact["autoscale_goodput_x"] = asc["goodput_x"]
+        compact["autoscale_p99_ms"] = asc["p99_ms"]
+        compact["autoscale_settle_s"] = asc["settle_s"]
+        compact["autoscale_scaled_to"] = asc["scaled_to"]
     if "obs_overhead" in out:
         compact["obs_overhead_pct"] = (
             out["obs_overhead"]["paired_overhead_pct"]["median"]

@@ -1,0 +1,49 @@
+"""Cross-host serving fleet (ISSUE 13 / ROADMAP item 1).
+
+PR 10's ``serve.Router`` proved scale-out *inside one process*: N
+per-device ``Replica`` Sessions behind one fence.  This package breaks
+that boundary the way libhpnn breaks it with MPI (SURVEY.md §0): each
+worker is an **unmodified** ``serve_nn`` / ``online_nn`` process
+speaking the existing HTTP wire protocol, and three cooperating parts
+turn a set of them into one serving fleet:
+
+* :mod:`hpnn_tpu.fleet.client` — ``WorkerHandle``, an HTTP client for
+  one worker (``/v1/infer``, ``/v1/ingest``, ``/v1/reload``,
+  ``/readyz``, ``/healthz``, ``/metrics``) that maps wire answers back
+  to the serve exception types (429 → ``Shed``, 504 →
+  ``DeadlineExceeded``, connection refused → ``WorkerGone``);
+* :mod:`hpnn_tpu.fleet.worker` — ``WorkerSupervisor``, which
+  forks/execs workers (port allocation, shared
+  ``HPNN_COMPILE_CACHE_DIR`` for warm boots, readiness-gated admission
+  via ``/readyz``, SIGTERM drain on scale-down with SIGKILL
+  escalation) and emits ``fleet.worker_up`` / ``fleet.worker_down``;
+* :mod:`hpnn_tpu.fleet.router` — ``ClusterRouter``, a Session-ish
+  front end (``make_server``, loadgen and the chaos drills compose
+  unchanged) fanning requests over the workers with least-outstanding
+  placement, per-worker cool-off, and fence-serialized reload fan-out
+  so concurrent infers answer bitwise old-or-new fleet-wide;
+* :mod:`hpnn_tpu.fleet.autoscaler` — a pure decision core
+  (:func:`decide`) plus a control loop that reads queue depth, shed
+  counts and the SLO burn rate and calls ``supervisor.spawn`` /
+  ``drain_and_kill`` under hysteresis, emitting ``fleet.scale_up`` /
+  ``fleet.scale_down``.
+
+Drive it end to end: ``python tools/bench_autoscale.py`` (autoscale
+demo), ``python tools/chaos_drill.py --drill worker`` (worker-loss
+drill).  Knobs and topology: docs/serving.md "Cross-host fleet".
+"""
+
+from hpnn_tpu.fleet.autoscaler import Autoscaler, Policy, decide
+from hpnn_tpu.fleet.client import WorkerGone, WorkerHandle
+from hpnn_tpu.fleet.router import ClusterRouter
+from hpnn_tpu.fleet.worker import WorkerSupervisor
+
+__all__ = [
+    "Autoscaler",
+    "ClusterRouter",
+    "Policy",
+    "WorkerGone",
+    "WorkerHandle",
+    "WorkerSupervisor",
+    "decide",
+]

@@ -354,6 +354,47 @@ class Session:
 _REQ_IDS = itertools.count(1)
 
 
+class _RateCap:
+    """Edge admission token bucket (``HPNN_SERVE_RATE_CAP``, rps).
+
+    Models one worker's bounded serving capacity at the admission
+    layer: above the cap, ``/v1/infer`` answers 429 with a fractional
+    ``Retry-After`` (the time until a token regenerates) and
+    ``reason="rate_cap"`` — the same shed surface the batcher uses, so
+    fleet routers cool off and autoscalers scale on it without new
+    plumbing (docs/serving.md "Cross-host fleet")."""
+
+    def __init__(self, rate_rps: float, *, burst_s: float = 0.25,
+                 clock=time.monotonic):
+        self.rate = float(rate_rps)
+        self.burst = max(1.0, self.rate * float(burst_s))
+        self._tokens = self.burst
+        self._clock = clock
+        self._t = clock()
+        self._lock = threading.Lock()
+
+    def try_admit(self) -> float | None:
+        """None = admitted (one token consumed); else seconds until
+        the next token regenerates (the Retry-After to answer)."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return None
+            return (1.0 - self._tokens) / self.rate
+
+
+def _rate_cap_from_env() -> _RateCap | None:
+    raw = os.environ.get("HPNN_SERVE_RATE_CAP", "").strip()
+    if not raw:
+        return None
+    rate = float(raw)  # junk raises: a silently dropped cap is a lie
+    return _RateCap(rate) if rate > 0 else None
+
+
 def _retry_after(exc: QueueFull) -> str:
     """The Retry-After header value for a retriable rejection."""
     if isinstance(exc, Shed):
@@ -445,6 +486,17 @@ class _Handler(BaseHTTPRequestHandler):
     def _infer(self, req: dict):
         if self._not_ready():
             return
+        cap = getattr(self.server, "rate_cap", None)
+        if cap is not None:
+            wait_s = cap.try_admit()
+            if wait_s is not None:
+                if obs.slo.enabled():
+                    obs.slo.record("shed")
+                self._reply(429, {"error": "rate cap exceeded",
+                                  "retriable": True,
+                                  "reason": "rate_cap"},
+                            headers={"Retry-After": f"{wait_s:.3f}"})
+                return
         name = req.get("kernel", "default")
         try:
             inputs = np.asarray(req.get("inputs"), dtype=np.float64)
@@ -602,6 +654,7 @@ def make_server(session: Session, host: str = "127.0.0.1",
     server = ThreadingHTTPServer((host, port), _Handler)
     server.daemon_threads = True
     server.session = session  # type: ignore[attr-defined]
+    server.rate_cap = _rate_cap_from_env()  # type: ignore[attr-defined]
     obs.event("serve.listen", host=host,
               port=server.server_address[1])
     return server

@@ -1,0 +1,227 @@
+#!/usr/bin/env python3
+"""End-to-end autoscale demo: a loadgen ramp the fleet rides.
+
+The acceptance measurement for the cross-host fleet (docs/serving.md
+"Cross-host fleet"): real ``serve_nn`` worker PROCESSES behind a
+:class:`~hpnn_tpu.fleet.router.ClusterRouter` HTTP edge, an
+:class:`~hpnn_tpu.fleet.autoscaler.Autoscaler` closing the loop on the
+router's own gauges, and ``tools/loadgen.py`` offering an open-loop
+ramp at ≥2x the single-worker plateau:
+
+1. spawn ONE worker, measure its saturation plateau closed-loop;
+2. offer ~2x that open-loop for ``ramp_s`` — the worker sheds, the
+   router's ``shed_total`` climbs, the autoscaler scales 1→N
+   (``fleet.scale_up``, readiness-gated spawns on a shared compile
+   cache);
+3. windowed goodput over the ramp's tail must reach ≥1.5x the
+   1-worker plateau with bounded p99 (``autoscale_goodput_x`` /
+   ``autoscale_p99_ms``);
+4. after the ramp the sheds age out of the calm window and the
+   autoscaler drains back to min width — ``autoscale_settle_s`` is
+   ramp-end → width 1.
+
+Per-worker capacity is pinned by ``HPNN_SERVE_RATE_CAP`` (the serve
+edge's admission token bucket, injected into the workers' env only):
+on a shared-core host N processes cannot multiply *CPU*, and an
+unbounded tiny-kernel worker would serve any offered rate from one
+core — capacity-bound workers are the regime autoscaling exists for,
+and the cap makes the 1→N goodput scaling REAL (each admitted request
+still runs the full HTTP + batcher + engine path).
+
+:func:`run_bench_autoscale` is the bench.py fold-in (compact keys
+``autoscale_goodput_x`` / ``autoscale_p99_ms`` /
+``autoscale_settle_s``, gated by tools/bench_gate.py); ``--json``
+prints the full document.  Skips cleanly (``"skipped"``) when the
+first worker cannot start.
+
+    JAX_PLATFORMS=cpu python tools/bench_autoscale.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+KERNEL = "auto"
+CONF = (f"[name] {KERNEL}\n[type] ANN\n[init] generate\n[seed] 7\n"
+        "[input] 8\n[hidden] 5\n[output] 2\n[train] BP\n")
+
+
+def run_bench_autoscale(*, cap_rps: float = 60.0, max_width: int = 3,
+                        ramp_s: float = 16.0, window_s: float = 4.0,
+                        seed: int = 9,
+                        ready_timeout_s: float = 90.0) -> dict:
+    """One full ramp ride (module doc); returns the result document."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import loadgen
+
+    from hpnn_tpu.fleet.autoscaler import Autoscaler, Policy
+    from hpnn_tpu.fleet.router import ClusterRouter
+    from hpnn_tpu.fleet.worker import WorkerSupervisor
+    from hpnn_tpu.serve.server import make_server
+
+    loadgen.shield_sigpipe()
+    out: dict = {"metric": "autoscale", "cap_rps": float(cap_rps),
+                 "max_width": int(max_width)}
+    workdir = tempfile.mkdtemp(prefix="hpnn_autoscale_")
+    conf_path = os.path.join(workdir, "nn.conf")
+    with open(conf_path, "w") as fp:
+        fp.write(CONF)
+
+    sup = WorkerSupervisor(
+        conf_path, workdir=workdir, kind="serve",
+        ready_timeout_s=ready_timeout_s,
+        env={"JAX_PLATFORMS": "cpu",
+             # capacity-bound workers (module doc): the cap lives in
+             # the WORKERS' env only — the router edge in this
+             # process stays uncapped
+             "HPNN_SERVE_RATE_CAP": str(cap_rps)})
+    router = None
+    server = None
+    scaler = None
+    try:
+        try:
+            sup.spawn()
+        except (RuntimeError, OSError) as exc:
+            out["skipped"] = f"worker spawn failed: {exc}"
+            out["ok"] = False
+            return out
+        router = ClusterRouter(supervisor=sup)
+        server = make_server(router, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        common = dict(kernels=(KERNEL,), rows_choices=(1,), n_in=8,
+                      timeout_s=2.0)
+
+        # ---- 1-worker plateau: closed loop, Retry-After honored so
+        # the probe measures the admitted rate, not a 429 storm
+        loadgen.run_closed_loop(url, n_clients=2, duration_s=0.4,
+                                seed=seed, max_retries=2,
+                                retry_cap_s=0.2, **common)  # warmup
+        sat = loadgen.run_closed_loop(
+            url, n_clients=4, duration_s=2.0, seed=seed,
+            max_retries=2, retry_cap_s=0.2, **common)
+        sat_rps = sat["goodput_rps"]
+        out["sat_rps_1worker"] = sat_rps
+        if not sat_rps:
+            out["skipped"] = "no goodput from the 1-worker probe"
+            out["ok"] = False
+            return out
+
+        # ---- the autoscaler rides the router's own gauges
+        policy = Policy(min_width=1, max_width=int(max_width),
+                        up_step=max(1, int(max_width) - 1),
+                        up_cooldown_s=2.0, down_for_s=3.0,
+                        down_cooldown_s=3.0)
+        scaler = Autoscaler(sup, router, policy=policy,
+                            interval_s=0.5)
+        widths: list[tuple[float, int]] = [(0.0, sup.width())]
+        stop_sampler = threading.Event()
+        t0 = time.monotonic()
+
+        def sampler():
+            while not stop_sampler.is_set():
+                w = sup.width()
+                if w != widths[-1][1]:
+                    widths.append((round(time.monotonic() - t0, 3), w))
+                stop_sampler.wait(0.1)
+
+        sampler_t = threading.Thread(target=sampler, daemon=True)
+        sampler_t.start()
+        scaler.start()
+
+        # ---- the ramp: well past 2x the plateau — above even the
+        # FULL fleet's capacity, so max width sheds steadily and the
+        # fleet holds wide instead of flapping calm/shed at the
+        # capacity boundary; width shrinks only after the ramp ends
+        offered = max(10.0, 3.3 * sat_rps)
+        records: list[dict] = []
+        rec_lock = threading.Lock()
+
+        def on_record(rec):
+            with rec_lock:
+                records.append(rec)
+
+        load = loadgen.run_open_loop(
+            url, rate_rps=offered, duration_s=ramp_s,
+            process="poisson", n_workers=16, seed=seed + 1,
+            max_retries=0, on_record=on_record, **common)
+        t_ramp_end = time.monotonic()
+        out["offered_rps"] = load["offered_rps"]
+        out["load"] = load
+
+        # ---- windowed goodput + p99 over the ramp's tail (scale-up
+        # has settled by then; rec["t"] is the scheduled arrival)
+        lo = ramp_s - window_s
+        tail_ok = [r for r in records
+                   if r["status"] == "ok" and r["t"] >= lo]
+        goodput_win = len(tail_ok) / window_s
+        lat_s = [r["latency_ms"] / 1e3 for r in tail_ok]
+        out["window_s"] = window_s
+        out["goodput_rps"] = round(goodput_win, 1)
+        out["goodput_x"] = round(goodput_win / sat_rps, 3)
+        out["p99_ms"] = (loadgen.percentile_ms(lat_s, 99)
+                         if lat_s else None)
+
+        # ---- scale back down once the ramp's sheds age out
+        settle_deadline = t_ramp_end + 45.0
+        while (sup.width() > policy.min_width
+               and time.monotonic() < settle_deadline):
+            time.sleep(0.1)
+        settled = sup.width() == policy.min_width
+        out["settle_s"] = (round(time.monotonic() - t_ramp_end, 3)
+                           if settled else None)
+        scaler.stop()
+        stop_sampler.set()
+        sampler_t.join(timeout=5.0)
+        out["width_timeline"] = widths
+        out["scaled_to"] = max(w for _t, w in widths)
+        up_t = [t for t, w in widths if w > 1]
+        out["react_s"] = round(up_t[0], 3) if up_t else None
+        out["ok"] = bool(
+            out["scaled_to"] >= 2
+            and out["goodput_x"] >= 1.5
+            and out["p99_ms"] is not None
+            and out["p99_ms"] < 2000.0
+            and settled)
+        return out
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if router is not None:
+            router.close()
+        sup.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="autoscaler ramp ride over real worker processes")
+    ap.add_argument("--cap", type=float, default=60.0,
+                    help="per-worker HPNN_SERVE_RATE_CAP (rps)")
+    ap.add_argument("--max-width", type=int, default=3)
+    ap.add_argument("--ramp-s", type=float, default=16.0)
+    ap.add_argument("--seed", type=int, default=9)
+    args = ap.parse_args(argv)
+    out = run_bench_autoscale(cap_rps=args.cap,
+                              max_width=args.max_width,
+                              ramp_s=args.ramp_s, seed=args.seed)
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,6 +120,20 @@ GATE_METRICS = {
     "collector_overhead_pct": ("lower", 2.00),
     "drill_alert_fire_s": ("lower", 1.50),
     "drill_alert_resolved": ("higher", 0.01),
+    # cross-host fleet fold-ins (tools/chaos_drill.py
+    # run_bench_worker_drill + tools/bench_autoscale.py;
+    # docs/serving.md "Cross-host fleet"): the worker-process kill
+    # drill's goodput dip and supervisor replacement latency (both
+    # subprocess-timing-noisy, so generous), and the autoscale ramp
+    # ride — windowed goodput over the 1-worker plateau (the
+    # acceptance floor is 1.5x, the gate guards the trajectory),
+    # windowed p99 under the scaled fleet, and ramp-end → min-width
+    # settle time
+    "drill_worker_dip_pct": ("lower", 1.00),
+    "drill_worker_replaced_s": ("lower", 1.50),
+    "autoscale_goodput_x": ("higher", 0.30),
+    "autoscale_p99_ms": ("lower", 1.00),
+    "autoscale_settle_s": ("lower", 1.50),
 }
 
 

@@ -41,15 +41,25 @@ catalog (docs/resilience.md):
   ``kill_replica(0)`` under traffic.  Asserts ``alert.fire`` lands
   (flight-recorder dump attached) within a bounded window and that
   ``spawn_replica()`` resolves it (``alert.resolve``).
+* **worker** — the cross-host twin of *replica*: a
+  :class:`~hpnn_tpu.fleet.worker.WorkerSupervisor` over N real
+  ``online_nn`` worker PROCESSES sharing one WAL, a
+  :class:`~hpnn_tpu.fleet.router.ClusterRouter` as the HTTP edge,
+  then SIGKILL one worker mid-stream.  Asserts the router routes
+  around the corpse (bounded dip, zero ``survivors_lost``, bitwise
+  survivor answers) and that the supervisor restart policy REPLACES
+  it — readiness-gated — within a bounded ``replaced_s``.
 
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
 ``drill.reload`` | ``drill.sentinel`` | ``drill.replica`` |
-``drill.alert``; :func:`run_bench_drill` /
-:func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` are
+``drill.alert`` | ``drill.worker``; :func:`run_bench_drill` /
+:func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` /
+:func:`run_bench_worker_drill` are
 the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
 ``drill_replica_dip_pct`` / ``drill_replica_survivors_lost`` /
-``drill_alert_fire_s`` / ``drill_alert_resolved``, gated by
+``drill_alert_fire_s`` / ``drill_alert_resolved`` /
+``drill_worker_dip_pct`` / ``drill_worker_replaced_s``, gated by
 ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
@@ -683,12 +693,102 @@ def drill_alert(workdir: str, *, rate: float = 60.0,
                 os.environ[key] = val
 
 
+def drill_worker(workdir: str, *, rate: float = 60.0,
+                 n_workers: int = 2, seed: int = 5,
+                 ready_timeout_s: float = 90.0) -> dict:
+    """Kill one of N worker PROCESSES behind a ClusterRouter under
+    load: a WorkerSupervisor over real ``online_nn`` children sharing
+    one WAL, the router as the HTTP edge, then SIGKILL one worker
+    mid-stream.  The cross-host route-around contract: bounded goodput
+    dip, zero ``survivors_lost``, bitwise survivor answers, and the
+    corpse REPLACED by the supervisor restart policy within a bounded
+    ``replaced_s``."""
+    from hpnn_tpu.fleet import ClusterRouter, WorkerSupervisor
+    from hpnn_tpu.fleet.router import CheckpointPublisher
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.online import wal as wal_mod
+    from hpnn_tpu.serve import make_server
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.worker", "ok": False,
+                 "workers": n_workers}
+    conf_path = os.path.join(workdir, "nn.conf")
+    with open(conf_path, "w") as fp:
+        fp.write(CONF)
+    wal_dir = os.path.join(workdir, "wal")
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    # seed the shared WAL so every worker restores (and can reload)
+    # the same resident weights
+    wal_mod.PromotionWAL(wal_dir).commit(KERNEL, k.weights, version=1,
+                                         reason="seed")
+    probe = np.linspace(-1.0, 1.0, 8)
+    sup = WorkerSupervisor(
+        conf_path, workdir=workdir, kind="online", wal_dir=wal_dir,
+        args=("--interval-s", "600"),   # trainer parked: the drill
+                                        # injures processes, not weights
+        ready_timeout_s=ready_timeout_s)
+    router = server = None
+    try:
+        try:
+            for _ in range(n_workers):
+                sup.spawn()
+        except (RuntimeError, OSError) as exc:
+            out["skipped"] = f"worker cannot start: {exc}"
+            return out
+        router = ClusterRouter(
+            supervisor=sup,
+            publisher=CheckpointPublisher(wal_dir=wal_dir))
+        before = np.asarray(router.infer(KERNEL, probe, timeout_s=10.0))
+        server = make_server(router)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        load = _Load(port, rate=rate, ingest_frac=0.0, seed=seed)
+        time.sleep(1.5)           # baseline bins
+        victim = sup.ranks()[0]
+        out["killed_rank"] = victim
+        t_kill = load.now()
+        sup.kill9(victim)
+        # the supervisor restart policy, timed: reap the corpse and
+        # spawn its replacement (readiness-gated, so "replaced" means
+        # SERVING, not just forked)
+        replaced = _wait(lambda: sup.replace_dead() or None, 30.0,
+                         interval_s=0.05)
+        t_replaced = load.now()
+        records = load.finish(settle_s=2.5)
+        after = np.asarray(router.infer(KERNEL, probe, timeout_s=10.0))
+        out.update(blast_radius(records, t_kill))
+        # the router is supposed to make the worker death invisible at
+        # the edge: after the kill settles, nothing may be lost
+        out["survivors_lost"] = sum(
+            1 for r in records
+            if r["status"] == "lost" and r["t"] >= t_kill + 0.25)
+        out["replaced_s"] = (round(t_replaced - t_kill, 3)
+                             if replaced else None)
+        out["width_after"] = sup.width()
+        out["survivor_bitwise"] = bool(np.array_equal(before, after))
+        out["ok"] = bool(out["recovery_s"] is not None
+                         and out["survivors_lost"] == 0
+                         and out["replaced_s"] is not None
+                         and out["width_after"] == n_workers
+                         and out["survivor_bitwise"])
+        return out
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if router is not None:
+            router.close()
+        sup.close()
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
     "sentinel": drill_sentinel,
     "replica": drill_replica,
     "alert": drill_alert,
+    "worker": drill_worker,
 }
 
 
@@ -774,16 +874,41 @@ def run_bench_replica_drill(*, rate: float = 80.0,
     return out
 
 
+def run_bench_worker_drill(*, rate: float = 60.0,
+                           n_workers: int = 2) -> dict:
+    """The bench.py fold-in for the worker drill: SIGKILL 1 of N
+    worker processes behind a ClusterRouter under load and report the
+    blast radius + replacement latency as gateable numbers
+    (``drill_worker_dip_pct`` / ``drill_worker_replaced_s``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_worker(tmp, rate=rate, n_workers=n_workers)
+    out = {
+        "metric": "worker_drill",
+        "drill": row,
+        "goodput_dip_pct": row.get("goodput_dip_pct"),
+        "recovery_s": row.get("recovery_s"),
+        "replaced_s": row.get("replaced_s"),
+        "survivors_lost": row.get("survivors_lost"),
+        "survivor_bitwise": row.get("survivor_bitwise"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 # --------------------------------------------------------------- main
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
-                    "(kill9 / reload / sentinel / replica / alert)")
+                    "(kill9 / reload / sentinel / replica / alert / "
+                    "worker)")
     ap.add_argument("--drill", default="all",
                     choices=("all", "kill9", "reload", "sentinel",
-                             "replica", "alert"))
+                             "replica", "alert", "worker"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

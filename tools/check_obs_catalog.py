@@ -86,11 +86,21 @@ without a finite pid makes the telemetry plane itself untrustworthy,
 so their shapes (and the per-rule fire/resolve pairing) are frozen
 too (docs/observability.md "Fleet telemetry").
 
+And the cross-host cluster schema lint (:func:`lint_cluster`): the
+``fleet.worker_up`` / ``fleet.worker_down`` membership edges
+(hpnn_tpu/fleet/worker.py) and the ``fleet.scale_up`` /
+``fleet.scale_down`` autoscaler actions (hpnn_tpu/fleet/autoscaler.py,
+docs/serving.md "Cross-host fleet") are how an operator reconstructs a
+width change — a worker death without a paired admission, a spawn
+without its latency, or a scale event with an infinite or shrinking
+"grow" width makes a capacity incident unauditable, so their shapes
+(and the per-rank up/down pairing) are frozen too.
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
-        [--serve-replicas PATH] [--fleet PATH]
+        [--serve-replicas PATH] [--fleet PATH] [--cluster PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -833,7 +843,7 @@ def lint_quant(path: str) -> list[str]:
 CHAOS_ACTIONS = ("kill", "raise", "delay", "nan")
 WAL_SKIP_REASONS = ("sig", "torn", "magic")
 DRILL_EVS = ("drill.kill9", "drill.reload", "drill.sentinel",
-             "drill.replica")
+             "drill.replica", "drill.worker")
 
 
 def lint_chaos(path: str) -> list[str]:
@@ -1002,24 +1012,33 @@ def lint_chaos(path: str) -> list[str]:
                     failures.append(
                         f"{at}: passing drill.kill9 recovery_s "
                         f"{rs!r} is not a non-negative number")
-            if ev == "drill.replica" and ok:
-                # the route-around contract: a passing replica drill
-                # PROVED zero loss on survivors and bitwise answers
+            if ev in ("drill.replica", "drill.worker") and ok:
+                # the route-around contract: a passing replica/worker
+                # drill PROVED zero loss on survivors and bitwise
+                # answers
                 if rec.get("survivors_lost") != 0:
                     failures.append(
-                        f"{at}: passing drill.replica with "
+                        f"{at}: passing {ev} with "
                         f"survivors_lost "
                         f"{rec.get('survivors_lost')!r} != 0")
                 if rec.get("survivor_bitwise") is not True:
                     failures.append(
-                        f"{at}: passing drill.replica without "
+                        f"{at}: passing {ev} without "
                         "survivor_bitwise=true — survivors were "
                         "never proven bitwise")
                 rs = rec.get("recovery_s")
                 if not _num(rs) or not math.isfinite(rs) or rs < 0:
                     failures.append(
-                        f"{at}: passing drill.replica recovery_s "
+                        f"{at}: passing {ev} recovery_s "
                         f"{rs!r} is not a non-negative number")
+            if ev == "drill.worker" and ok:
+                # a passing worker drill must also prove the dead
+                # worker was REPLACED (the supervisor restart policy)
+                rp = rec.get("replaced_s")
+                if not _num(rp) or not math.isfinite(rp) or rp < 0:
+                    failures.append(
+                        f"{at}: passing drill.worker replaced_s "
+                        f"{rp!r} is not a non-negative number")
     if not n_seen:
         failures.append(
             f"{path!r} has no chaos.* / wal.* / drill.* / "
@@ -1296,6 +1315,200 @@ def lint_fleet(path: str) -> list[str]:
     return failures
 
 
+# the cross-host cluster record contracts (hpnn_tpu/fleet/,
+# docs/serving.md "Cross-host fleet")
+SCALE_EVS = ("fleet.scale_up", "fleet.scale_down")
+
+
+def lint_cluster(path: str) -> list[str]:
+    """Schema-lint the cross-host fleet records of one JSONL file — a
+    metrics sink from a supervisor/autoscaler run (bench autoscale
+    demo, worker drill, or a live fleet edge).
+
+    Checks, per record:
+
+    * ``fleet.worker_up`` events — ``rank`` a non-negative int,
+      ``port`` an int in [1, 65535], ``pid`` a positive int, and a
+      finite non-negative ``spawn_s`` (a worker admission that can't
+      say how long the boot took hides the warm-boot regression the
+      shared compile cache exists to prevent).
+    * ``fleet.worker_down`` events — ``rank`` a non-negative int, a
+      non-empty ``reason``, a finite non-negative ``alive_s``.
+    * **Pairing** — a ``worker_down`` for a rank never admitted, or a
+      second ``worker_up`` for a rank still up, fails (ranks are
+      never reused by the supervisor); workers still up at EOF are
+      fine (a live fleet).
+    * ``fleet.scale_up`` / ``fleet.scale_down`` events — finite int
+      widths >= 1 with ``to_width`` strictly greater (up) / smaller
+      (down) than ``from_width``, and a non-empty ``reason``.
+    * ``fleet.width`` gauges — finite ``value`` >= 1 (an empty fleet
+      gauge is a supervisor bug).
+    * ``cluster.route`` / ``cluster.shed_around`` counts and
+      ``cluster.outstanding`` gauges — the ``router.*`` twins: an
+      attributable non-negative ``rank``; a non-empty ``kernel`` /
+      ``reason``; a finite non-negative outstanding value.
+    * ``cluster.fence`` events — non-empty ``op`` and ``kernel``,
+      ``workers`` an int >= 1.
+
+    A file with no ``fleet.worker_*`` / ``fleet.scale_*`` records
+    fails — this lint only makes sense on a run that actually managed
+    a fleet.  Returns failure strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read {path!r}: {exc}"]
+
+    def _rank_ok(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= 0
+
+    def _width_ok(v) -> bool:
+        return (isinstance(v, int) and not isinstance(v, bool)
+                and v >= 1)
+
+    n_cluster = 0
+    up_ranks: set = set()
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev == "fleet.worker_up":
+            n_cluster += 1
+            rank = rec.get("rank")
+            if not _rank_ok(rank):
+                failures.append(
+                    f"{at}: fleet.worker_up rank {rank!r} is not a "
+                    "non-negative int")
+            elif rank in up_ranks:
+                failures.append(
+                    f"{at}: fleet.worker_up rank {rank} admitted "
+                    "twice without a worker_down between (ranks are "
+                    "never reused)")
+            else:
+                up_ranks.add(rank)
+            p = rec.get("port")
+            if (not isinstance(p, int) or isinstance(p, bool)
+                    or not 1 <= p <= 65535):
+                failures.append(
+                    f"{at}: fleet.worker_up port {p!r} is not an int "
+                    "in [1, 65535]")
+            if not _pos_int(rec.get("pid")):
+                failures.append(
+                    f"{at}: fleet.worker_up pid {rec.get('pid')!r} is "
+                    "not a positive int")
+            sp = rec.get("spawn_s")
+            if not _num(sp) or not math.isfinite(sp) or sp < 0:
+                failures.append(
+                    f"{at}: fleet.worker_up spawn_s {sp!r} is not a "
+                    "finite non-negative number — spawn latency is a "
+                    "required field")
+        elif ev == "fleet.worker_down":
+            n_cluster += 1
+            rank = rec.get("rank")
+            if not _rank_ok(rank):
+                failures.append(
+                    f"{at}: fleet.worker_down rank {rank!r} is not a "
+                    "non-negative int")
+            elif rank not in up_ranks:
+                failures.append(
+                    f"{at}: fleet.worker_down rank {rank} was never "
+                    "admitted (no paired fleet.worker_up)")
+            else:
+                up_ranks.discard(rank)
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r:
+                failures.append(
+                    f"{at}: fleet.worker_down reason {r!r} is not a "
+                    "non-empty string")
+            al = rec.get("alive_s")
+            if not _num(al) or not math.isfinite(al) or al < 0:
+                failures.append(
+                    f"{at}: fleet.worker_down alive_s {al!r} is not a "
+                    "finite non-negative number")
+        elif ev in SCALE_EVS:
+            n_cluster += 1
+            fw, tw = rec.get("from_width"), rec.get("to_width")
+            if not _width_ok(fw) or not _width_ok(tw):
+                failures.append(
+                    f"{at}: {ev} widths {fw!r} -> {tw!r} are not "
+                    "ints >= 1")
+            elif ev == "fleet.scale_up" and tw <= fw:
+                failures.append(
+                    f"{at}: fleet.scale_up to_width {tw} <= "
+                    f"from_width {fw} — not a scale-up")
+            elif ev == "fleet.scale_down" and tw >= fw:
+                failures.append(
+                    f"{at}: fleet.scale_down to_width {tw} >= "
+                    f"from_width {fw} — not a scale-down")
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r:
+                failures.append(
+                    f"{at}: {ev} reason {r!r} is not a non-empty "
+                    "string")
+        elif ev == "fleet.width" and rec.get("kind") == "gauge":
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 1:
+                failures.append(
+                    f"{at}: fleet.width gauge {v!r} is not a finite "
+                    "number >= 1")
+        elif ev == "cluster.route":
+            if not _rank_ok(rec.get("rank")):
+                failures.append(
+                    f"{at}: cluster.route rank {rec.get('rank')!r} is "
+                    "not a non-negative int")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: cluster.route kernel {k!r} is not a "
+                    "non-empty string")
+        elif ev == "cluster.shed_around":
+            if not _rank_ok(rec.get("rank")):
+                failures.append(
+                    f"{at}: cluster.shed_around rank "
+                    f"{rec.get('rank')!r} is not a non-negative int")
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r:
+                failures.append(
+                    f"{at}: cluster.shed_around reason {r!r} is not a "
+                    "non-empty string")
+        elif ev == "cluster.outstanding" and rec.get("kind") == "gauge":
+            if not _rank_ok(rec.get("rank")):
+                failures.append(
+                    f"{at}: cluster.outstanding rank "
+                    f"{rec.get('rank')!r} is not a non-negative int")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: cluster.outstanding gauge {v!r} is not a "
+                    "finite number >= 0")
+        elif ev == "cluster.fence":
+            for key in ("op", "kernel"):
+                v = rec.get(key)
+                if not isinstance(v, str) or not v:
+                    failures.append(
+                        f"{at}: cluster.fence {key} {v!r} is not a "
+                        "non-empty string")
+            if not _pos_int(rec.get("workers")):
+                failures.append(
+                    f"{at}: cluster.fence workers "
+                    f"{rec.get('workers')!r} is not an int >= 1")
+    if not n_cluster:
+        failures.append(
+            f"{path!r} has no fleet.worker_* / fleet.scale_* records "
+            "— was a WorkerSupervisor/Autoscaler active during this "
+            "run?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1353,6 +1566,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_fleet(argv[i + 1])
+    if "--cluster" in argv:
+        i = argv.index("--cluster")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --cluster needs a "
+                             "path\n")
+            return 2
+        failures += lint_cluster(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -24,7 +24,10 @@ attribution (``HPNN_SPANS`` / ``HPNN_COST``), the SLO tracker
 (``HPNN_SLO_MS`` — load shedding is additionally exercised to an
 actual Shed rejection in the serve section below, and the serve
 section also routes a 2-replica Router round trip with the
-persistent compile cache armed, ``HPNN_COMPILE_CACHE_DIR``), the whole
+persistent compile cache armed, ``HPNN_COMPILE_CACHE_DIR``, and a
+2-worker cross-host ``ClusterRouter`` round trip over real HTTP —
+fan-out infers plus a fenced ``CheckpointPublisher`` install re-read
+by both workers over ``/v1/reload``), the whole
 ``HPNN_ONLINE_*`` train-while-serve knob family (inert outside
 ``hpnn_tpu/online/``; a full feed → train → gate → rollback round is
 additionally exercised to silence below), the chaos + durability
@@ -61,6 +64,7 @@ import os
 import re
 import sys
 import tempfile
+import threading
 import time
 
 TOKEN_PREFIXES = ("NN: ", "NN(WARN): ", "NN(ERR): ", "NN(DBG): ",
@@ -440,12 +444,66 @@ def check(tmpdir: str) -> list[str]:
             "2-replica Router round trip wrote stdout: "
             f"{router_buf.getvalue()[:120]!r}")
 
+    # Cross-host fleet (hpnn_tpu/fleet/, docs/serving.md "Cross-host
+    # fleet") rides the same silence contract: TWO in-process HTTP
+    # workers (Session + make_server on ephemeral ports — the same
+    # wire surface a real worker process exposes), WorkerHandles, a
+    # ClusterRouter fanning infers over them, and a fenced
+    # CheckpointPublisher install_kernel promotion re-read by both
+    # workers over /v1/reload — not one stdout byte from any of it
+    # (worker HTTP request logs go to stderr by design).
+    from hpnn_tpu.fileio import checkpoint as fileio_ckpt
+    from hpnn_tpu.fleet.client import WorkerHandle
+    from hpnn_tpu.fleet.router import CheckpointPublisher, ClusterRouter
+    from hpnn_tpu.serve.server import make_server
+
+    cluster_buf = io.StringIO()
+    cluster_path = os.path.join(tmpdir, "lint_cluster.ckpt")
+    fileio_ckpt.dump_checkpoint(cluster_path, "lint_cluster",
+                                k.weights, version=1)
+    with contextlib.redirect_stdout(cluster_buf):
+        sessions, servers, handles = [], [], []
+        try:
+            for rank in range(2):
+                sess = serve.Session(max_batch=8, n_buckets=1,
+                                     max_wait_ms=0.5)
+                sess.load_kernel("lint_cluster", cluster_path)
+                srv = make_server(sess, port=0)
+                threading.Thread(target=srv.serve_forever,
+                                 daemon=True).start()
+                sessions.append(sess)
+                servers.append(srv)
+                handles.append(WorkerHandle(
+                    rank, "127.0.0.1", srv.server_address[1]))
+            cluster = ClusterRouter(
+                workers=handles,
+                publisher=CheckpointPublisher(
+                    {"lint_cluster": cluster_path},
+                    versions={"lint_cluster": 1}))
+            cluster.infer("lint_cluster", np.zeros(8))
+            cluster.infer("lint_cluster", np.zeros((3, 8)))
+            k4, _ = kernel_mod.generate(17, 8, [5], 2)
+            cluster.install_kernel("lint_cluster", k4)
+            cluster.infer("lint_cluster", np.zeros(8))
+            cluster.close()
+        finally:
+            for srv in servers:
+                srv.shutdown()
+                srv.server_close()
+            for sess in sessions:
+                sess.close()
+    if cluster_buf.getvalue():
+        failures.append(
+            "2-worker ClusterRouter round trip wrote stdout: "
+            f"{cluster_buf.getvalue()[:120]!r}")
+
     with_serve = _run_round(os.path.join(tmpdir, "c"), None)
     if plain != with_serve:
         failures.append(
             "stdout is NOT byte-identical after importing/exercising "
             "hpnn_tpu.serve (per-kernel + fleet + 2-replica Router "
-            "with the persistent compile cache armed), train.fleet, "
+            "with the persistent compile cache armed + 2-worker "
+            "ClusterRouter over HTTP), train.fleet, "
             f"and hpnn_tpu.online (plain {len(plain)}B vs "
             f"with-serve {len(with_serve)}B)")
 
