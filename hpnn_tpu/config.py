@@ -299,3 +299,264 @@ def dump_kernel(conf: NNConf, fp) -> None:
         conf.kernel,
         fp,
     )
+
+
+# --------------------------------------------------------------------
+# The central HPNN_* knob registry (docs/analysis.md).
+#
+# Every environment knob the runtime reads is declared here — default,
+# owning doc page, one-line description — and tools/hpnnlint enforces
+# the contract both ways: a knob read in source but missing a row, a
+# row whose page never mentions the knob, a row nothing reads anymore,
+# and a doc mention of an undeclared knob are all lint failures.
+#
+# This MUST stay a pure literal (ast.literal_eval-able): the linter
+# parses it without importing jax.  ``default`` is the value the code
+# falls back to when the knob is unset (None = armed-by-presence).
+# Knobs read outside the lint scope (bench.py, the test harness)
+# declare their ``reader`` file explicitly so the no-dead-rows check
+# can verify them.
+KNOBS = {
+    # --- observability core (docs/observability.md) ---
+    "HPNN_METRICS": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "append structured JSONL events to this path"},
+    "HPNN_FLIGHT": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "arm the flight recorder; dump path on crash/abort"},
+    "HPNN_FLIGHT_N": {
+        "default": 256, "doc": "docs/observability.md",
+        "desc": "flight-ring capacity (floor 8)"},
+    "HPNN_PROBES": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "per-tensor numerics probe events at every check"},
+    "HPNN_NUMERICS": {
+        "default": "warn", "doc": "docs/observability.md",
+        "desc": "numerics sentinel mode: warn|abort"},
+    "HPNN_LEDGER": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "checksum-ledger JSONL path ({rank} expands)"},
+    "HPNN_SPANS": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "lifecycle spans: span.end event per finished span"},
+    "HPNN_COST": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "compiled-cost attribution + perf.* gauges"},
+    "HPNN_PEAK_FLOPS": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "override the perf.mfu peak-FLOPs denominator"},
+    "HPNN_TRACE": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "the #DBG numeric-oracle stdout stream"},
+    "HPNN_LOCKWATCH": {
+        "default": None, "doc": "docs/analysis.md",
+        "desc": "arm the lock-order watchdog on named locks"},
+    # --- SLO / shedding (docs/observability.md) ---
+    "HPNN_SLO_MS": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "arm the rolling SLO tracker: latency target in ms"},
+    "HPNN_SLO_WINDOW_S": {
+        "default": 60, "doc": "docs/observability.md",
+        "desc": "SLO rolling-window length in seconds"},
+    "HPNN_SLO_TARGET": {
+        "default": 0.99, "doc": "docs/observability.md",
+        "desc": "SLO attainment target in (0, 1)"},
+    "HPNN_SHED_AGE_MS": {
+        "default": 0, "doc": "docs/observability.md",
+        "desc": "shed submits once queue head ages past this (0=off)"},
+    "HPNN_SHED_P99_MS": {
+        "default": 0, "doc": "docs/observability.md",
+        "desc": "shed submits once window p99 crosses this (0=off)"},
+    # --- fleet telemetry (docs/observability.md) ---
+    "HPNN_COLLECTOR": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "push records to a central collector URL"},
+    "HPNN_COLLECTOR_QUEUE": {
+        "default": 2048, "doc": "docs/observability.md",
+        "desc": "collector client push-queue capacity"},
+    "HPNN_COLLECTOR_FLUSH_S": {
+        "default": 0.25, "doc": "docs/observability.md",
+        "desc": "collector client flush cadence in seconds"},
+    "HPNN_ALERTS": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "alert rule grammar over the live gauge stream"},
+    # --- chaos / durability (docs/resilience.md) ---
+    "HPNN_CHAOS": {
+        "default": None, "doc": "docs/resilience.md",
+        "desc": "deterministic fault-injection plan at named seams"},
+    "HPNN_CHAOS_SEED": {
+        "default": 0, "doc": "docs/resilience.md",
+        "desc": "seed for the per-fault RNG streams"},
+    "HPNN_WAL_DIR": {
+        "default": None, "doc": "docs/resilience.md",
+        "desc": "promotion write-ahead-log directory"},
+    # --- serving (docs/serving.md) ---
+    "HPNN_SERVE_MODE": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "serve engine mode override (parity|batched)"},
+    "HPNN_SERVE_DTYPE": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "default low-precision serve policy: bf16|f32|f64"},
+    "HPNN_SERVE_FLEET": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "serve_nn drains batches through the fleet group path"},
+    "HPNN_SERVE_RATE_CAP": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "token-bucket admission cap: rate[:burst] per second"},
+    "HPNN_SERVE_REPLICAS": {
+        "default": 1, "doc": "docs/serving.md",
+        "desc": "default replica count for serve.Router"},
+    "HPNN_SERVE_SPILL": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "router spills oversized blocks to the TP forward"},
+    "HPNN_COMPILE_CACHE_DIR": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "persistent compiled-executable cache directory"},
+    # --- cross-host fleet autoscaler (docs/serving.md) ---
+    "HPNN_FLEET_MIN": {
+        "default": 1, "doc": "docs/serving.md",
+        "desc": "autoscaler floor: minimum worker width"},
+    "HPNN_FLEET_MAX": {
+        "default": 4, "doc": "docs/serving.md",
+        "desc": "autoscaler ceiling: maximum worker width"},
+    "HPNN_FLEET_UP_OUTSTANDING": {
+        "default": 8.0, "doc": "docs/serving.md",
+        "desc": "scale up past this many rows in flight per worker"},
+    "HPNN_FLEET_DOWN_OUTSTANDING": {
+        "default": 1.0, "doc": "docs/serving.md",
+        "desc": "scale down below this many rows in flight per worker"},
+    "HPNN_FLEET_UP_BURN": {
+        "default": 1.0, "doc": "docs/serving.md",
+        "desc": "scale up once SLO burn rate crosses this"},
+    "HPNN_FLEET_DOWN_BURN": {
+        "default": 0.5, "doc": "docs/serving.md",
+        "desc": "scale down only while burn rate is under this"},
+    "HPNN_FLEET_UP_STEP": {
+        "default": 2, "doc": "docs/serving.md",
+        "desc": "workers added per scale-up decision"},
+    "HPNN_FLEET_DOWN_STEP": {
+        "default": 1, "doc": "docs/serving.md",
+        "desc": "workers removed per scale-down decision"},
+    "HPNN_FLEET_UP_COOLDOWN_S": {
+        "default": 3.0, "doc": "docs/serving.md",
+        "desc": "minimum seconds between scale-ups"},
+    "HPNN_FLEET_DOWN_COOLDOWN_S": {
+        "default": 15.0, "doc": "docs/serving.md",
+        "desc": "minimum seconds between scale-downs"},
+    "HPNN_FLEET_DOWN_FOR_S": {
+        "default": 5.0, "doc": "docs/serving.md",
+        "desc": "calm must be sustained this long before scaling down"},
+    # --- online learning (docs/online.md) ---
+    "HPNN_ONLINE_BUFFER": {
+        "default": 1024, "doc": "docs/online.md",
+        "desc": "stream ingest ring capacity"},
+    "HPNN_ONLINE_RESERVOIR": {
+        "default": 0, "doc": "docs/online.md",
+        "desc": "reservoir-sample size (0 = plain ring)"},
+    "HPNN_ONLINE_HOLDOUT": {
+        "default": 8, "doc": "docs/online.md",
+        "desc": "rows held out for candidate evaluation"},
+    "HPNN_ONLINE_ROWS": {
+        "default": 64, "doc": "docs/online.md",
+        "desc": "training-window rows per online round"},
+    "HPNN_ONLINE_BATCH": {
+        "default": 8, "doc": "docs/online.md",
+        "desc": "minibatch rows inside one online round"},
+    "HPNN_ONLINE_EPOCHS": {
+        "default": 4, "doc": "docs/online.md",
+        "desc": "epochs per online round"},
+    "HPNN_ONLINE_INTERVAL_S": {
+        "default": 1.0, "doc": "docs/online.md",
+        "desc": "seconds between online training rounds"},
+    "HPNN_ONLINE_SCAN_K": {
+        "default": 1, "doc": "docs/online.md",
+        "desc": "online rounds scanned inside one dispatch (K>1)"},
+    "HPNN_ONLINE_MARGIN": {
+        "default": 0.01, "doc": "docs/online.md",
+        "desc": "relative loss margin a candidate must beat"},
+    "HPNN_ONLINE_WATCH_S": {
+        "default": 30.0, "doc": "docs/online.md",
+        "desc": "post-promotion regression-watch window seconds"},
+    # --- training / dispatch (docs/performance.md) ---
+    "HPNN_DTYPE": {
+        "default": None, "doc": "docs/performance.md",
+        "desc": "training dtype override (f32|f64)"},
+    "HPNN_FUSE_EPOCH": {
+        "default": "1", "doc": "docs/performance.md",
+        "desc": "fuse whole epochs into one dispatch (0 disables)"},
+    "HPNN_FUSE_CHUNK": {
+        "default": 1024, "doc": "docs/performance.md",
+        "desc": "samples per fused-round chunk dispatch"},
+    "HPNN_FUSE_STATE": {
+        "default": None, "doc": "docs/performance.md",
+        "desc": "crash-resume checkpoint path for fused rounds"},
+    "HPNN_DISPATCH_BUDGET_S": {
+        "default": 60, "doc": "docs/performance.md",
+        "desc": "dispatch-time budget driving chunk halving"},
+    "HPNN_BANK": {
+        "default": "1", "doc": "docs/performance.md",
+        "desc": "device-side sample bank (0 = legacy per-step gather)"},
+    "HPNN_BANK_REFRESH": {
+        "default": 8, "doc": "docs/performance.md",
+        "desc": "epochs per bank composition refresh group"},
+    "HPNN_BANK_DBUF": {
+        "default": None, "doc": "docs/performance.md",
+        "desc": "double-buffered bank epoch kernel (1 enables)"},
+    "HPNN_FAST_COUNT": {
+        "default": None, "doc": "docs/performance.md",
+        "desc": "drop the highest pin on the in-training eval count"},
+    "HPNN_PALLAS": {
+        "default": "0", "doc": "docs/performance.md",
+        "desc": "force the Mosaic per-sample kernel path (1 enables)"},
+    "HPNN_NO_BATCH_EVAL": {
+        "default": None, "doc": "docs/performance.md",
+        "desc": "force the per-sample eval path (parity debugging)"},
+    "HPNN_NO_NATIVE": {
+        "default": None, "doc": "docs/performance.md",
+        "desc": "force pure-Python paths over native kernels"},
+    # --- bench harness (bench.py, outside the lint scope) ---
+    "HPNN_BENCH_HISTORY": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "append bench summary rows to this JSONL history",
+        "reader": "bench.py"},
+    "HPNN_BENCH_DETAIL": {
+        "default": None, "doc": "docs/analysis.md",
+        "desc": "print per-case bench detail rows",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_OBS_OVERHEAD": {
+        "default": None, "doc": "docs/analysis.md",
+        "desc": "skip the obs-overhead bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_LOAD": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "skip the serve load/SLO bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_ONLINE": {
+        "default": None, "doc": "docs/online.md",
+        "desc": "skip the online-learning bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_QUANT": {
+        "default": None, "doc": "docs/performance.md",
+        "desc": "skip the low-precision bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_DRILL": {
+        "default": None, "doc": "docs/resilience.md",
+        "desc": "skip the chaos-drill bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_FLEET": {
+        "default": None, "doc": "docs/fleet.md",
+        "desc": "skip the fleet bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_SERVE": {
+        "default": None, "doc": "docs/analysis.md",
+        "desc": "skip the serve bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_REPLICAS": {
+        "default": None, "doc": "docs/analysis.md",
+        "desc": "skip the multi-replica bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_AUTOSCALE": {
+        "default": None, "doc": "docs/analysis.md",
+        "desc": "skip the autoscaler bench section",
+        "reader": "bench.py"},
+}

@@ -70,8 +70,8 @@ class CheckpointPublisher:
         if (paths is None) == (wal_dir is None):
             raise ValueError("pass exactly one of paths= or wal_dir=")
         self._paths = dict(paths) if paths is not None else None
-        self._versions = dict(versions or {})
-        self._lock = threading.Lock()
+        self._lock = obs.lockwatch.lock("fleet.publisher")
+        self._versions = dict(versions or {})  # guarded: _lock
         if wal_dir is not None:
             from hpnn_tpu.online import wal as wal_mod
 
@@ -136,16 +136,16 @@ class ClusterRouter:
         self._sup = supervisor
         self._publisher = publisher
         self._clock = clock
-        self._fence = threading.Lock()
+        self._fence = obs.lockwatch.lock("fleet.router.fence")
         # rank -> monotonic instant its cool-off expires (PR 10 shape)
-        self._cool: dict[int, float] = {}
-        self._cool_lock = threading.Lock()
-        self._versions: dict[str, int] = {}
-        self._routed = 0
-        self._shed = 0
-        self._stat_lock = threading.Lock()
-        self._ready = True
-        self._closed = False
+        self._cool_lock = obs.lockwatch.lock("fleet.router.cool")
+        self._cool: dict[int, float] = {}      # guarded: _cool_lock
+        self._versions: dict[str, int] = {}    # guarded: _fence
+        self._stat_lock = obs.lockwatch.lock("fleet.router.stat")
+        self._routed = 0                       # guarded: _stat_lock
+        self._shed = 0                         # guarded: _stat_lock
+        self._ready = True                     # guarded: _stat_lock
+        self._closed = False                   # guarded: _stat_lock
         # the Session plug points make_server consumes
         self.ingest_hook = self._ingest
         self.online_health = None
@@ -317,11 +317,13 @@ class ClusterRouter:
 
     # -------------------------------------------------------- readiness
     def mark_unready(self, reason: str) -> None:
-        self._ready = False
-        self._unready_reason = reason
+        with self._stat_lock:
+            self._ready = False
+            self._unready_reason = reason
 
     def mark_ready(self) -> None:
-        self._ready = True
+        with self._stat_lock:
+            self._ready = True
 
     def is_ready(self) -> bool:
         """Ready iff the edge is not draining AND any worker answers
@@ -416,8 +418,9 @@ class ClusterRouter:
     def close(self) -> None:
         """Close the edge (handles stay open when a supervisor owns
         them — draining processes is the supervisor's job)."""
-        self._closed = True
-        self._ready = False
+        with self._stat_lock:
+            self._closed = True
+            self._ready = False
         if self._static is not None:
             for h in self._static:
                 h.close()

@@ -49,7 +49,12 @@ structured side channel next to it:
   over gauge streams with threshold / SLO burn-rate / EWMA z-score
   rules firing ``alert.fire``/``alert.resolve`` with a flight dump
   attached — ``HPNN_ALERTS`` (obs/alerts.py; drill:
-  ``tools/chaos_drill.py --drill alert``).
+  ``tools/chaos_drill.py --drill alert``);
+* the lock-order watchdog: named locks feeding a process-global
+  acquisition-order graph, where a cycle is a latent deadlock and
+  fails the armed test run with both acquisition stacks —
+  ``HPNN_LOCKWATCH`` (obs/lockwatch.py; static twin:
+  ``tools/hpnnlint``, docs/analysis.md).
 
 Typical instrumentation site::
 
@@ -60,12 +65,15 @@ Typical instrumentation site::
     obs.observe("train.n_iter", stats[1], chunk_end=done)
     obs.count("fallback.mosaic_refusal")
 
-Event-name catalog and schema: docs/observability.md.
+Event-name catalog and schema: docs/observability.md.  Static
+contracts over this package (catalog drift, knob registry, lock
+discipline, swallowed exceptions): ``tools/hpnnlint``,
+docs/analysis.md.
 """
 
 from hpnn_tpu.obs import (alerts, collector, cost, device, export,
-                          flight, ledger, probes, propagate, slo,
-                          spans)
+                          flight, ledger, lockwatch, probes,
+                          propagate, slo, spans)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -101,6 +109,7 @@ __all__ = [
     "flush",
     "gauge",
     "ledger",
+    "lockwatch",
     "observe",
     "probes",
     "propagate",

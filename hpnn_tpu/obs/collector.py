@@ -219,8 +219,8 @@ def _reset_for_tests() -> None:
     if isinstance(c, _Client):
         try:
             c.close()
-        except Exception:
-            pass
+        except (OSError, RuntimeError):
+            pass  # socket already dead / thread already joined
 
 
 # ------------------------------------------------------------ server
@@ -450,8 +450,8 @@ class Collector:
         if self._fp is not None:
             try:
                 self._fp.close()
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # already closed
 
 
 class _CollectorHandler(BaseHTTPRequestHandler):

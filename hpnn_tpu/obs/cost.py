@@ -94,8 +94,8 @@ def peak_flops() -> float:
                 import jax
 
                 backend = jax.default_backend()
-            except Exception:
-                pass
+            except (ImportError, RuntimeError):
+                pass  # no usable backend: fall through to "cpu"
             v = _DEFAULT_PEAK.get(backend, _DEFAULT_PEAK["cpu"])
         _peak = v
     return _peak
@@ -162,8 +162,11 @@ def note_executable(name: str, compiled, units: int = 1,
             v = getattr(mem, attr, None)
             if v is not None:
                 rec[key] = int(v)
-    except Exception:
-        pass
+    except Exception as exc:
+        # same contract as the cost_analysis block above: the AOT
+        # surface is unstable across jax versions, so record what
+        # broke instead of losing the whole rec
+        rec.setdefault("error", type(exc).__name__)
     if compile_s is not None:
         rec["compile_s"] = round(float(compile_s), 6)
     _emit_cost(name, rec)

@@ -57,7 +57,9 @@ def _install_compile_listeners() -> None:
         monitoring.register_event_listener(_on_event)
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception:
-        pass  # counters stay at 0; the gauges are still emitted
+        # counters stay at 0; the gauges are still emitted — but
+        # count the degradation so a dashboard can see it happened
+        registry.count("obs.swallow", where="device.compile_listeners")
 
 
 def compile_stats() -> dict:
@@ -73,7 +75,7 @@ def sample(phase: str, step: int | None = None) -> None:
         return
     try:
         import jax
-    except Exception:
+    except ImportError:
         return
     _install_compile_listeners()
     fields = {"phase": phase}
@@ -82,15 +84,15 @@ def sample(phase: str, step: int | None = None) -> None:
 
     try:
         devices = jax.local_devices()
-    except Exception:
-        devices = []
+    except RuntimeError:
+        devices = []  # backend failed to initialize
     in_use = peak = 0
     have_stats = False
     for d in devices:
         try:
             ms = d.memory_stats()
-        except Exception:
-            ms = None
+        except (RuntimeError, NotImplementedError, AttributeError):
+            ms = None  # backend doesn't report memory
         if ms:
             have_stats = True
             used = int(ms.get("bytes_in_use", 0))
@@ -106,12 +108,14 @@ def sample(phase: str, step: int | None = None) -> None:
         for a in live:
             try:
                 live_bytes += int(a.nbytes)
-            except Exception:
-                pass
+            except (AttributeError, TypeError, ValueError):
+                pass  # deleted buffer or opaque array: skip it
         registry.gauge("device.live_arrays", len(live), **fields)
         registry.gauge("device.live_array_bytes", live_bytes, **fields)
     except Exception:
-        pass
+        # census is best-effort, but a silently missing gauge looks
+        # like "no leak" — count the swallow so absence is auditable
+        registry.count("obs.swallow", where="device.live_arrays")
 
     registry.gauge("device.compile_events", _compile["events"], **fields)
     registry.gauge("device.compile_time_s",

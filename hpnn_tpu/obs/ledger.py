@@ -174,5 +174,5 @@ def _reset_for_tests() -> None:
         if isinstance(st, _Ledger):
             try:
                 st.fp.close()
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # already closed

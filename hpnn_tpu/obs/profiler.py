@@ -43,7 +43,7 @@ def annotate(name: str, **metadata):
         import jax
 
         return jax.profiler.TraceAnnotation(name, **metadata)
-    except Exception:
+    except (ImportError, AttributeError):
         return _NULL_CTX
 
 
@@ -54,5 +54,5 @@ def step_annotation(name: str, step: int):
         import jax
 
         return jax.profiler.StepTraceAnnotation(name, step_num=step)
-    except Exception:
+    except (ImportError, AttributeError):
         return _NULL_CTX

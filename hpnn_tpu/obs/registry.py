@@ -185,8 +185,8 @@ def _process_index() -> int:
         from hpnn_tpu import runtime
 
         return runtime.process_index()
-    except Exception:
-        return 0
+    except (ImportError, RuntimeError):
+        return 0  # no jax / uninitialized backend: single-process
 
 
 def _init():
@@ -472,8 +472,9 @@ def _crash_flush(ev: str, detail: str, reason: str) -> None:
         summary()
         flush()
         flight.dump(reason)
+    # hpnnlint: ignore[swallow] -- crash path: obs must never mask
     except Exception:
-        pass
+        pass  # the original exception with one of its own
 
 
 def _install_crash_handlers() -> None:
@@ -523,8 +524,9 @@ def _at_exit() -> None:
             summary()
             if st.fp is not None:
                 st.fp.close()
+        # hpnnlint: ignore[swallow] -- atexit: interpreter teardown,
         except Exception:
-            pass
+            pass  # half-dead modules raise arbitrary errors here
 
 
 def _reset_for_tests() -> None:
@@ -544,8 +546,8 @@ def _reset_for_tests() -> None:
         if isinstance(st, _State) and st.fp is not None:
             try:
                 st.fp.close()
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # already closed
     flight._reset_for_tests()
     # chain the sibling memos; sys.modules.get avoids import cycles
     # (export/ledger/probes all import registry; chaos/wal import obs)
@@ -553,7 +555,7 @@ def _reset_for_tests() -> None:
                  "hpnn_tpu.obs.probes", "hpnn_tpu.obs.cost",
                  "hpnn_tpu.obs.spans", "hpnn_tpu.obs.slo",
                  "hpnn_tpu.obs.propagate", "hpnn_tpu.obs.collector",
-                 "hpnn_tpu.obs.alerts",
+                 "hpnn_tpu.obs.alerts", "hpnn_tpu.obs.lockwatch",
                  "hpnn_tpu.chaos", "hpnn_tpu.online.wal"):
         mod = sys.modules.get(name)
         if mod is not None:

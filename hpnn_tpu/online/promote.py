@@ -114,12 +114,13 @@ class Promoter:
         self.gate = gate or Gate()
         self.wal = wal                # PromotionWAL | None (no disk)
         self._clock = clock
-        self._lock = threading.Lock()
-        self._prior: dict[str, object] = {}    # name -> prior Entry
-        self._watch: dict[str, dict] = {}      # name -> armed watch
-        self.stats = {"promoted": 0, "rejected": 0, "rollbacks": 0}
-        self.last_promote_latency_s: float | None = None
-        self.last_losses: dict[str, tuple] = {}
+        self._lock = obs.lockwatch.lock("online.promote")
+        self._prior: dict[str, object] = {}    # guarded: _lock
+        self._watch: dict[str, dict] = {}      # guarded: _lock
+        self.stats = {"promoted": 0, "rejected": 0,
+                      "rollbacks": 0}          # guarded: _lock
+        self.last_promote_latency_s: float | None = None  # guarded: _lock
+        self.last_losses: dict[str, tuple] = {}           # guarded: _lock
 
     # ----------------------------------------------------------- verdict
     def _reject(self, name: str, reason: str, **fields) -> str:
@@ -180,7 +181,8 @@ class Promoter:
                              model=resident.model)
         obs.gauge("online.candidate_loss", cand_loss, kernel=name)
         obs.gauge("online.resident_loss", res_loss, kernel=name)
-        self.last_losses[name] = (cand_loss, res_loss)
+        with self._lock:
+            self.last_losses[name] = (cand_loss, res_loss)
         if not np.isfinite(cand_loss):
             return self._reject(name, REJECT_SENTINEL, step=step,
                                 detail="non-finite eval loss")

@@ -60,7 +60,7 @@ class PromotionWAL:
         self.dir = str(dir)
         self.path = os.path.join(self.dir, WAL_NAME)
         self.keep = max(1, int(keep))
-        self._lock = threading.Lock()
+        self._lock = obs.lockwatch.lock("online.wal")
         os.makedirs(self.dir, exist_ok=True)
 
     # ------------------------------------------------------------ write

@@ -50,8 +50,8 @@ def _census(name: str, axis, x, **fields) -> None:
     try:
         shape = [int(s) for s in x.shape]
         nbytes = math.prod(shape) * x.dtype.itemsize
-    except Exception:
-        shape, nbytes = None, None
+    except (AttributeError, TypeError):
+        shape, nbytes = None, None  # tracer without concrete shape
     obs.event(name, axis=str(axis), shape=shape, bytes=nbytes, **fields)
 
 

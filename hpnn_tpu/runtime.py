@@ -334,8 +334,8 @@ def process_index() -> int:
         import jax
 
         return jax.process_index()
-    except Exception:
-        return 0
+    except (ImportError, RuntimeError):
+        return 0  # no jax / uninitialized backend: single-process
 
 
 def n_devices() -> int:

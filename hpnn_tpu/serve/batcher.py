@@ -148,12 +148,12 @@ class Batcher:
         self.shed_p99_ms = float(shed_p99_ms)
         self._clock = clock
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = obs.lockwatch.lock("serve.batcher")
         self._cond = threading.Condition(self._lock)
-        self._queue: deque[_Request] = deque()
-        self._shed: dict[str, int] = {}   # cumulative, per reason
-        self._expired = 0                 # cumulative deadline drops
-        self._closed = False
+        self._queue: deque[_Request] = deque()  # guarded: _lock
+        self._shed: dict[str, int] = {}   # guarded: _lock (per reason)
+        self._expired = 0                 # guarded: _lock
+        self._closed = False              # guarded: _lock
         self._thread: threading.Thread | None = None
         if start:
             self._thread = threading.Thread(

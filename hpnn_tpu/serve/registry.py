@@ -88,8 +88,8 @@ class Registry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._entries: dict[str, Entry] = {}
+        self._lock = obs.lockwatch.lock("serve.registry")
+        self._entries: dict[str, Entry] = {}  # guarded: _lock
 
     # ------------------------------------------------------------ install
     def register(

@@ -50,7 +50,6 @@ Architecture: docs/serving.md#scale-out.
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 import numpy as np
@@ -170,18 +169,18 @@ class Router:
         self._clock = clock
         self._session_kwargs = dict(session_kwargs)
         self.replicas = [Replica(rank, clock=clock, **session_kwargs)
-                         for rank in range(n_replicas)]
+                         for rank in range(n_replicas)]  # guarded: _fence
         # one fence for every mutation fan-out: replicas see
         # promotions in the same order, and a spawning replica never
         # races a half-applied install
-        self._fence = threading.Lock()
+        self._fence = obs.lockwatch.lock("serve.router.fence")
         # rank -> monotonic instant its shed cool-off expires
-        self._cool: dict[int, float] = {}
-        self._cool_lock = threading.Lock()
+        self._cool_lock = obs.lockwatch.lock("serve.router.cool")
+        self._cool: dict[int, float] = {}  # guarded: _cool_lock
         # (name, version) -> (tp_run_fn, sharded_weights, n_out)
-        self._tp_cache: dict = {}
-        self._tp_lock = threading.Lock()
-        self._mesh = None
+        self._tp_lock = obs.lockwatch.lock("serve.router.tp")
+        self._tp_cache: dict = {}          # guarded: _tp_lock
+        self._mesh = None                  # guarded: _tp_lock
         # the online-learning layer plugs in exactly as on a Session
         self.ingest_hook = None
         self.online_health = None

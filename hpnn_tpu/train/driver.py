@@ -591,8 +591,9 @@ def _load_fuse_state(path, key):
             "resume_done": int(z["resume_done"]) if "resume_done" in z else -1,
             "weights": tuple(z[f"w{i}"] for i in range(n)),
         }
+    # hpnnlint: ignore[swallow] -- any parse error (zip, key, dtype)
     except Exception:
-        return None  # unreadable/partial checkpoint: start over
+        return None  # means unreadable/partial checkpoint: start over
 
 
 def _save_fuse_state(path, key, seed, done, chunk, weights, resume_done=-1):

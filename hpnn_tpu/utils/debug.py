@@ -91,8 +91,8 @@ def device_alloc_report(device_arrays, fp=None) -> int:
     for w in device_arrays:
         try:
             shards = list(w.addressable_shards)
-        except Exception:
-            continue
+        except (AttributeError, RuntimeError):
+            continue  # host array or deleted buffer: nothing to map
         for s in shards:
             by_dev[s.device] = by_dev.get(s.device, 0) + s.data.nbytes
     if not by_dev:

@@ -51,3 +51,17 @@ def _reset_obs_memos():
     yield
     trace._reset_enabled_cache()
     obs._reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _lockwatch_cycle_gate():
+    """With HPNN_LOCKWATCH=1 exported, every test doubles as a
+    lock-order probe: any cycle the test's lock traffic added to the
+    acquisition-order graph fails THAT test with both stacks
+    (docs/analysis.md).  Declared after _reset_obs_memos so this
+    teardown runs before the reset clears the graph.  Unarmed: no-op."""
+    yield
+    from hpnn_tpu.obs import lockwatch
+
+    if lockwatch.enabled():
+        lockwatch.check()

@@ -1,0 +1,486 @@
+"""hpnnlint static analysis suite (tools/hpnnlint, docs/analysis.md).
+
+Two halves:
+
+* the **repo-clean gate** — the engine runs in-process over
+  ``hpnn_tpu/`` + ``tools/`` and any finding fails tier-1, so the
+  tree is lint-clean by construction;
+* **accept/break ladders** per rule over tmp fixture trees — each
+  seeded single-rule violation must produce exactly the expected
+  finding (and a non-zero exit), each compliant twin must pass.
+
+Plus the pragma grammar (reason mandatory, bare pragma is itself a
+finding), the ``--json`` schema, and the 0/1/2 exit-code contract.
+The engine is stdlib-only: no jax anywhere in this file's imports.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from tools.hpnnlint import engine
+from tools.hpnnlint.rules import all_rules
+from tools.hpnnlint.rules.lock_discipline import LockDisciplineRule
+from tools.hpnnlint.rules.swallow import SwallowRule
+from tools.hpnnlint.rules.trace_purity import TracePurityRule
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(tmp_path, files):
+    """Write a fixture tree; returns its root as str."""
+    for rel, src in files.items():
+        full = tmp_path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_text(textwrap.dedent(src), encoding="utf-8")
+    return str(tmp_path)
+
+
+def _lint(tmp_path, files, paths=("pkg",), rules=None):
+    root = _tree(tmp_path, files)
+    findings, _n = engine.run(root, list(paths), rules=rules)
+    return findings
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------ repo-clean gate
+def test_repo_is_lint_clean():
+    """THE gate: any finding anywhere in hpnn_tpu/ or tools/ fails
+    tier-1 with the rendered file:line evidence."""
+    findings, n_files = engine.run(REPO_ROOT, ["hpnn_tpu", "tools"])
+    assert n_files > 50
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_cli_module_entry_point_clean_and_json():
+    """`python -m tools.hpnnlint hpnn_tpu tools --json` — the exact
+    command docs/analysis.md ships — exits 0 with the v1 schema."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.hpnnlint", "hpnn_tpu", "tools",
+         "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1
+    assert doc["findings"] == [] and doc["counts"] == {}
+    assert doc["files"] > 50
+
+
+# ------------------------------------------------------------- swallow
+BROKEN_SWALLOW = """\
+    def f():
+        try:
+            risky()
+        except Exception:
+            pass
+"""
+
+
+def test_swallow_breaks_on_silent_broad_except(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": BROKEN_SWALLOW})
+    assert [f.rule for f in findings] == ["swallow"]
+    assert findings[0].file == os.path.join("pkg", "m.py")
+    assert findings[0].line == 4          # the `except` line
+
+
+def test_swallow_breaks_on_bare_except_and_silent_return(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        def f():
+            try:
+                risky()
+            except:
+                return None
+    """})
+    assert [f.rule for f in findings] == ["swallow"]
+
+
+def test_swallow_accepts_narrow_observable_or_raising(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        def f():
+            try:
+                risky()
+            except OSError:          # narrow: fine silent
+                pass
+            try:
+                risky()
+            except Exception as exc:
+                record(exc)          # observable
+            try:
+                risky()
+            except Exception:
+                raise RuntimeError("ctx")   # re-raise
+    """})
+    assert findings == []
+
+
+# ------------------------------------------------------------- pragma
+def test_pragma_same_line_suppresses(tmp_path):
+    src = BROKEN_SWALLOW.replace(
+        "except Exception:",
+        "except Exception:  # hpnnlint: ignore[swallow] -- demo waiver")
+    assert _lint(tmp_path, {"pkg/m.py": src}) == []
+
+
+def test_pragma_comment_line_above_suppresses(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        def f():
+            try:
+                risky()
+            # hpnnlint: ignore[swallow] -- benign by design (demo)
+            except Exception:
+                pass
+    """})
+    assert findings == []
+
+
+def test_pragma_without_reason_is_itself_a_finding(tmp_path):
+    src = BROKEN_SWALLOW.replace(
+        "except Exception:",
+        "except Exception:  # hpnnlint: ignore[swallow]")
+    findings = _lint(tmp_path, {"pkg/m.py": src})
+    # the mute button doesn't work AND the bad pragma is reported
+    assert _rules_of(findings) == ["pragma", "swallow"]
+
+
+def test_pragma_wrong_rule_does_not_suppress(tmp_path):
+    src = BROKEN_SWALLOW.replace(
+        "except Exception:",
+        "except Exception:  # hpnnlint: ignore[trace-purity] -- wrong")
+    findings = _lint(tmp_path, {"pkg/m.py": src})
+    assert [f.rule for f in findings] == ["swallow"]
+
+
+# ----------------------------------------------------- lock-discipline
+LOCKED_CLASS = """\
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+            self._items = []        # guarded: _lock
+            self.n = 0              # guarded: _lock
+
+        def ok_with(self, x):
+            with self._lock:
+                self._items.append(x)
+                self.n += 1
+
+        def ok_alias(self, x):
+            with self._cond:        # Condition(lock) == the lock
+                self._items = [x]
+"""
+
+
+def test_lock_discipline_accepts_guarded_writes(tmp_path):
+    assert _lint(tmp_path, {"pkg/m.py": LOCKED_CLASS}) == []
+
+
+def test_lock_discipline_breaks_on_off_lock_writes(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": LOCKED_CLASS + """\
+
+        def bad_plain(self, x):
+            self._items = [x]
+
+        def bad_mutator(self, x):
+            self._items.append(x)
+
+        def bad_aug(self):
+            self.n += 1
+    """})
+    assert [f.rule for f in findings] == ["lock-discipline"] * 3
+    assert all("guarded: _lock" in f.msg for f in findings)
+
+
+def test_lock_discipline_breaks_on_subscript_and_closure(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._map = {}      # guarded: _lock
+
+            def bad_item(self, k, v):
+                self._map[k] = v
+
+            def bad_closure(self, k):
+                with self._lock:
+                    def cb():       # may run on another thread
+                        self._map[k] = 1
+                    return cb
+    """})
+    assert [f.rule for f in findings] == ["lock-discipline"] * 2
+
+
+def test_lock_discipline_flags_guard_typo(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []    # guarded: _locck
+    """})
+    assert [f.rule for f in findings] == ["lock-discipline"]
+    assert "typo" in findings[0].msg
+
+
+def test_lock_discipline_bare_acquire(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        import threading
+        LK = threading.Lock()
+
+        def bad():
+            LK.acquire()
+            work()
+            LK.release()
+
+        def good():
+            LK.acquire()
+            try:
+                work()
+            finally:
+                LK.release()
+    """})
+    assert [f.rule for f in findings] == ["lock-discipline"]
+    assert "bare LK.acquire()" in findings[0].msg
+
+
+# -------------------------------------------------------- trace-purity
+def test_trace_purity_breaks_on_host_calls_in_jit(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x + t
+    """})
+    assert [f.rule for f in findings] == ["trace-purity"]
+    assert "time.time" in findings[0].msg
+
+
+def test_trace_purity_sees_one_hop_into_scan_body(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        import os
+        import jax
+
+        def helper(c):
+            return c, os.environ.get("X")
+
+        def body(c, x):
+            return helper(c)
+
+        def run(xs):
+            return jax.lax.scan(body, 0, xs)
+    """})
+    assert [f.rule for f in findings] == ["trace-purity"]
+    assert "os.environ" in findings[0].msg
+    assert "helper" in findings[0].msg        # the one-hop context
+
+
+def test_trace_purity_accepts_pure_traced_fn(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": """\
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def host_side():
+            return time.time()      # not traced: fine
+    """})
+    assert findings == []
+
+
+# --------------------------------------------------------- obs-catalog
+OBS_FIXTURE_SRC = """\
+    from hpnn_tpu.obs import registry
+
+    def f():
+        registry.count("serve.hit", n=1)
+"""
+
+
+def test_obs_catalog_breaks_both_directions(tmp_path):
+    findings = _lint(tmp_path, {
+        "hpnn_tpu/m.py": OBS_FIXTURE_SRC + """\
+
+        def g():
+            registry.event("serve.nope")
+        """,
+        "docs/observability.md": """\
+            | name | kind | meaning |
+            |---|---|---|
+            | `serve.hit` | count | ok |
+            | `serve.ghost` | event | emitter retired |
+        """,
+    }, paths=("hpnn_tpu",))
+    assert [f.rule for f in findings] == ["obs-catalog"] * 2
+    by_file = {f.file: f for f in findings}
+    emit = by_file[os.path.join("hpnn_tpu", "m.py")]
+    assert "`serve.nope`" in emit.msg and "missing" in emit.msg
+    row = by_file["docs/observability.md"]
+    assert "`serve.ghost`" in row.msg and row.line == 4
+
+
+def test_obs_catalog_accepts_documented_and_wildcard(tmp_path):
+    findings = _lint(tmp_path, {
+        "hpnn_tpu/m.py": OBS_FIXTURE_SRC + """\
+
+        def g(i):
+            registry.gauge(f"fleet.worker{i}.depth", v=1)
+        """,
+        "docs/observability.md": """\
+            | `serve.hit` | count | ok |
+            | `fleet.*` | gauge | per-worker family |
+        """,
+    }, paths=("hpnn_tpu",))
+    assert findings == []
+
+
+# ------------------------------------------------------- knob-registry
+def _knob_tree(knobs_literal, module_src, doc_text):
+    return {
+        "hpnn_tpu/config.py": f"KNOBS = {knobs_literal}\n",
+        "hpnn_tpu/m.py": module_src,
+        "docs/observability.md": doc_text,
+    }
+
+
+GOOD_KNOBS = ('{"HPNN_DEMO": {"default": "0", '
+              '"doc": "docs/observability.md", "desc": "demo knob"}}')
+READS_DEMO = 'import os\nV = os.environ.get("HPNN_DEMO", "0")\n'
+
+
+def test_knob_registry_accepts_full_contract(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _knob_tree(GOOD_KNOBS, READS_DEMO, "set HPNN_DEMO=1 to demo\n"),
+        paths=("hpnn_tpu",))
+    assert findings == []
+
+
+def test_knob_registry_breaks_on_undeclared_read(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _knob_tree(GOOD_KNOBS,
+                   READS_DEMO + 'W = os.environ.get("HPNN_ROGUE")\n',
+                   "set HPNN_DEMO=1\n"),
+        paths=("hpnn_tpu",))
+    assert [f.rule for f in findings] == ["knob-registry"]
+    assert "`HPNN_ROGUE`" in findings[0].msg
+    assert findings[0].file == os.path.join("hpnn_tpu", "m.py")
+
+
+def test_knob_registry_breaks_on_dead_row(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _knob_tree(GOOD_KNOBS, "X = 1\n", "set HPNN_DEMO=1\n"),
+        paths=("hpnn_tpu",))
+    assert [f.rule for f in findings] == ["knob-registry"]
+    assert "retire the row" in findings[0].msg
+
+
+def test_knob_registry_breaks_on_undocumented_knob(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _knob_tree(GOOD_KNOBS, READS_DEMO, "no knobs here\n"),
+        paths=("hpnn_tpu",))
+    assert [f.rule for f in findings] == ["knob-registry"]
+    assert "never mentions the knob" in findings[0].msg
+
+
+def test_knob_registry_breaks_on_stale_doc_mention(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _knob_tree(GOOD_KNOBS, READS_DEMO,
+                   "set HPNN_DEMO=1; HPNN_GONE was removed\n"),
+        paths=("hpnn_tpu",))
+    assert [f.rule for f in findings] == ["knob-registry"]
+    assert "`HPNN_GONE`" in findings[0].msg
+
+
+def test_knob_registry_breaks_on_non_literal_table(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _knob_tree("dict(x=1)", READS_DEMO, ""),
+        paths=("hpnn_tpu",))
+    assert any(f.rule == "knob-registry"
+               and "pure literal" in f.msg for f in findings)
+
+
+# ------------------------------------------------- engine / exit codes
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    findings = _lint(tmp_path, {"pkg/m.py": "def broken(:\n"})
+    assert [f.rule for f in findings] == ["parse"]
+
+
+def test_rule_selection_runs_only_named_rule(tmp_path):
+    files = {"pkg/m.py": BROKEN_SWALLOW + """\
+
+        import threading
+        LK = threading.Lock()
+
+        def also_bad():
+            LK.acquire()
+            work()
+    """}
+    both = _lint(tmp_path, dict(files))
+    assert _rules_of(both) == ["lock-discipline", "swallow"]
+    only = _lint(tmp_path, dict(files), rules=[SwallowRule()])
+    assert _rules_of(only) == ["swallow"]
+
+
+def test_findings_sorted_and_rendered(tmp_path):
+    findings = _lint(tmp_path, {
+        "pkg/a.py": BROKEN_SWALLOW,
+        "pkg/b.py": BROKEN_SWALLOW,
+    })
+    assert [f.file for f in findings] == [
+        os.path.join("pkg", "a.py"), os.path.join("pkg", "b.py")]
+    assert findings[0].render() == (
+        f"{os.path.join('pkg', 'a.py')}:4: [swallow] "
+        f"{findings[0].msg}")
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    root = _tree(tmp_path, {
+        "clean/m.py": "X = 1\n",
+        "dirty/m.py": BROKEN_SWALLOW,
+    })
+    assert engine.main(["--root", root, "clean"]) == 0
+    assert engine.main(["--root", root, "dirty"]) == 1
+    assert engine.main(["--root", root, "--rule", "nonsense",
+                        "clean"]) == 2
+    assert engine.main(["--totally-bogus-flag"]) == 2
+    capsys.readouterr()
+
+
+def test_main_json_schema_on_findings(tmp_path, capsys):
+    root = _tree(tmp_path, {"dirty/m.py": BROKEN_SWALLOW})
+    assert engine.main(["--root", root, "--json", "dirty"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1 and doc["files"] == 1
+    assert doc["counts"] == {"swallow": 1}
+    f = doc["findings"][0]
+    assert set(f) == {"rule", "file", "line", "msg"}
+    assert f["rule"] == "swallow" and f["line"] == 4
+
+
+def test_all_rules_have_unique_names():
+    rules = all_rules()
+    names = [r.name for r in rules]
+    assert len(set(names)) == len(names) == 5
+    assert {"obs-catalog", "knob-registry", "lock-discipline",
+            "swallow", "trace-purity"} == set(names)
+    assert isinstance(rules[2], LockDisciplineRule)
+    assert isinstance(rules[4], TracePurityRule)

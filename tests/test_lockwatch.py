@@ -1,0 +1,193 @@
+"""Lock-order watchdog (hpnn_tpu/obs/lockwatch.py, docs/analysis.md).
+
+Acceptance bar (ISSUE): a 2-lock order cycle under HPNN_LOCKWATCH=1
+is detected and reported with BOTH acquisition stacks.  Also proven
+here: unarmed zero-overhead (plain threading.Lock back), Condition
+compatibility (the serve batcher wraps its watched lock in one), the
+wired serve/online objects really carry watched locks under their
+documented role names, and live traffic through them leaves the
+graph acyclic so the conftest cycle gate passes.
+"""
+
+import threading
+
+import pytest
+
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import lockwatch
+
+
+def _arm(monkeypatch):
+    monkeypatch.setenv(lockwatch.ENV_KNOB, "1")
+    lockwatch._reset_for_tests()
+
+
+def _kernel(seed=7):
+    k, _ = kernel_mod.generate(seed, 8, [5], 2)
+    return k
+
+
+# --------------------------------------------------------------- unarmed
+def test_unarmed_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv(lockwatch.ENV_KNOB, raising=False)
+    lockwatch._reset_for_tests()
+    lk = lockwatch.lock("x")
+    assert not isinstance(lk, lockwatch._WatchedLock)
+    with lk:                      # still a perfectly good lock
+        pass
+    assert lockwatch.edges() == {}
+    lockwatch.check()             # vacuous: nothing recorded
+
+
+def test_unarmed_memoizes_one_env_read(monkeypatch):
+    monkeypatch.delenv(lockwatch.ENV_KNOB, raising=False)
+    lockwatch._reset_for_tests()
+    assert lockwatch.enabled() is False
+    # flipping env after the memo must not re-arm mid-process
+    monkeypatch.setenv(lockwatch.ENV_KNOB, "1")
+    assert lockwatch.enabled() is False
+    lockwatch._reset_for_tests()  # explicit reset re-reads
+    assert lockwatch.enabled() is True
+
+
+# ----------------------------------------------------------------- armed
+def test_armed_records_edges_no_cycle(monkeypatch):
+    _arm(monkeypatch)
+    a, b = lockwatch.lock("a"), lockwatch.lock("b")
+    with a:
+        with b:
+            pass
+    assert ("a", "b") in lockwatch.edges()
+    assert ("b", "a") not in lockwatch.edges()
+    assert lockwatch.cycles() == []
+    lockwatch.check()             # consistent order: passes
+
+
+def test_reentry_is_not_an_ordering(monkeypatch):
+    _arm(monkeypatch)
+    a1, a2 = lockwatch.lock("a"), lockwatch.lock("a")  # same role
+    with a1:
+        with a2:                  # distinct objects, same name
+            pass
+    assert lockwatch.edges() == {}
+
+
+def test_two_lock_cycle_detected_with_both_stacks(monkeypatch):
+    """The ISSUE acceptance criterion: a -> b then b -> a raises with
+    each edge's two acquisition stacks in the report."""
+    _arm(monkeypatch)
+    a, b = lockwatch.lock("serve.demo.a"), lockwatch.lock("serve.demo.b")
+
+    def take_a_then_b():
+        with a:
+            with b:
+                pass
+
+    def take_b_then_a():
+        with b:
+            with a:
+                pass
+
+    take_a_then_b()
+    take_b_then_a()               # no deadlock: order evidence only
+    assert lockwatch.cycles() != []
+    with pytest.raises(lockwatch.LockOrderError) as exc:
+        lockwatch.check()
+    text = str(exc.value)
+    assert "serve.demo.a -> serve.demo.b" in text
+    assert "serve.demo.b -> serve.demo.a" in text
+    # both stacks per edge: the two call sites that built the cycle
+    assert "take_a_then_b" in text
+    assert "take_b_then_a" in text
+    assert text.count("acquired at") >= 4   # 2 edges x 2 stacks
+    lockwatch._reset_for_tests()  # don't trip the conftest gate
+
+
+def test_cycle_across_threads(monkeypatch):
+    """Order evidence composes across threads — the scenario a real
+    deadlock needs, caught without any actual contention."""
+    _arm(monkeypatch)
+    a, b = lockwatch.lock("t.a"), lockwatch.lock("t.b")
+    with a:
+        with b:
+            pass
+
+    def other():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert lockwatch.cycles() != []
+    lockwatch._reset_for_tests()
+
+
+def test_condition_over_watched_lock(monkeypatch):
+    """threading.Condition(lockwatch.lock(...)) must work armed — the
+    serve batcher's exact shape."""
+    _arm(monkeypatch)
+    lk = lockwatch.lock("cond.demo")
+    cond = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cond:
+        hits.append(1)
+        cond.notify()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert lockwatch.cycles() == []
+
+
+# ------------------------------------------------------- wired lock roles
+def test_wired_objects_carry_watched_roles(monkeypatch, tmp_path):
+    _arm(monkeypatch)
+    from hpnn_tpu.online.promote import Promoter
+    from hpnn_tpu.online.wal import PromotionWAL
+    from hpnn_tpu.serve import batcher as batcher_mod
+    from hpnn_tpu.serve.registry import Registry
+
+    reg = Registry()
+    wal = PromotionWAL(str(tmp_path))
+    bat = batcher_mod.Batcher(lambda p: list(p), max_batch=4, start=False)
+    prom = Promoter(session=None)
+    assert isinstance(reg._lock, lockwatch._WatchedLock)
+    assert reg._lock.name == "serve.registry"
+    assert wal._lock.name == "online.wal"
+    assert bat._lock.name == "serve.batcher"
+    assert prom._lock.name == "online.promote"
+
+
+def test_armed_live_traffic_stays_acyclic(monkeypatch, tmp_path):
+    """Drive real registry/batcher/WAL traffic with the watchdog armed:
+    everything behaves, and the acquisition graph the traffic leaves
+    behind has no cycles (so the conftest gate would pass)."""
+    _arm(monkeypatch)
+    from hpnn_tpu.online.wal import PromotionWAL
+    from hpnn_tpu.serve import batcher as batcher_mod
+    from hpnn_tpu.serve.registry import Registry
+
+    reg = Registry()
+    k = _kernel()
+    e = reg.register("k", k)
+    assert reg.get("k") is e
+
+    bat = batcher_mod.Batcher(lambda p: list(p), max_batch=8, start=False)
+    reqs = [bat.submit(i, rows=1) for i in range(3)]
+    assert bat.drain_once() == 3
+    assert [bat.result(r, timeout_s=0) for r in reqs] == [0, 1, 2]
+
+    wal = PromotionWAL(str(tmp_path))
+    rec = wal.commit("k", k.weights, version=1)
+    assert rec["ev"] == "wal.commit"
+
+    assert lockwatch.cycles() == []
+    lockwatch.check()

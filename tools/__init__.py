@@ -1,0 +1,6 @@
+"""Repo tooling: benches, drills, lints.
+
+Most entries are standalone scripts (``python tools/bench_gate.py``);
+``tools/hpnnlint/`` is a package so the static-analysis suite runs as
+``python -m tools.hpnnlint`` (docs/analysis.md).
+"""

@@ -128,7 +128,7 @@ DOC_RE = re.compile(
 
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
              "docs/fleet.md", "docs/online.md", "docs/resilience.md",
-             "docs/performance.md")
+             "docs/performance.md", "docs/analysis.md")
 SRC_DIR = "hpnn_tpu"
 
 

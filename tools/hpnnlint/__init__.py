@@ -1,0 +1,45 @@
+"""hpnnlint — the repo-native static analysis suite.
+
+The runtime lints (tools/check_obs_catalog.py, tools/check_tokens.py)
+prove properties of what a run *emitted*; anything tier-1 never
+exercises ships unseen.  hpnnlint closes that class statically: an
+AST pass over ``hpnn_tpu/`` + ``tools/`` that enforces the repo's
+cross-cutting contracts before any traffic exists to violate them.
+
+Rules (tools/hpnnlint/rules/, catalog in docs/analysis.md):
+
+* ``obs-catalog``    — every literal event name fed to
+  ``event/count/gauge/observe/timer/span/start`` (and raw
+  ``"ev": ...`` records) is documented, and every docs catalog-table
+  row names an event the source can actually emit;
+* ``knob-registry``  — every ``HPNN_*`` environ knob referenced in
+  source is declared in the central ``hpnn_tpu.config.KNOBS`` table
+  (default + owning doc page), the owning page mentions it, and
+  neither the table nor the docs carry rows for knobs no longer read;
+* ``lock-discipline`` — fields annotated ``# guarded: <lock>`` are
+  only written inside a ``with <lock>`` block, and bare
+  ``.acquire()`` calls without a try/finally release are flagged;
+* ``swallow``        — ``except Exception: pass`` must narrow the
+  type, emit an obs count, or carry a reasoned pragma;
+* ``trace-purity``   — no ``time.time()`` / ``os.environ`` /
+  ``np.random`` / host ``.item()`` reachable (one call-graph hop)
+  inside functions handed to ``jit``/``vmap``/``scan``/
+  ``pallas_call``.
+
+Suppression: ``# hpnnlint: ignore[rule] -- reason`` on the finding
+line (or alone on the line above).  The reason is mandatory — a bare
+pragma is itself a finding (rule ``pragma``).
+
+Run::
+
+    python -m tools.hpnnlint hpnn_tpu tools [--json]
+
+Exit 0 = clean, 1 = findings, 2 = usage/internal error.  The runtime
+complement — the lock-order watchdog the ``lock-discipline`` rule
+pairs with — is ``hpnn_tpu/obs/lockwatch.py`` (``HPNN_LOCKWATCH``).
+stdlib-only.
+"""
+
+from tools.hpnnlint.engine import Finding, main, run
+
+__all__ = ["Finding", "main", "run"]

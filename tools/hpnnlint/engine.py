@@ -1,0 +1,213 @@
+"""The hpnnlint engine: file walking, pragma grammar, rule driving.
+
+A :class:`Rule` sees every linted file once (:meth:`Rule.check_file`)
+and then gets one :meth:`Rule.finalize` call for cross-file checks
+(doc catalogs, the knob table).  Findings carry ``rule``/``file``/
+``line``/``msg``; the engine owns suppression, ordering, rendering,
+and exit codes so rules stay pure.
+
+Pragma grammar (docs/analysis.md)::
+
+    # hpnnlint: ignore[rule1,rule2] -- why this is safe
+
+The reason text after the bracket is mandatory; a reasonless pragma
+is reported under the (unsuppressable) ``pragma`` rule.  A pragma
+suppresses findings on its own line, or — when it is a comment-only
+line — on the line below.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from typing import Iterable, NamedTuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*hpnnlint:\s*ignore\[([a-z\-, ]+)\]\s*(?:--|:)?\s*(\S.*)?$")
+
+SKIP_DIRS = {"__pycache__", ".git"}
+
+
+class Finding(NamedTuple):
+    rule: str
+    file: str       # repo-relative path
+    line: int       # 1-based
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.msg}"
+
+
+class FileCtx:
+    """One parsed source file: text, AST, and its pragma index."""
+
+    def __init__(self, root: str, rel: str, text: str,
+                 tree: ast.Module):
+        self.root = root
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree
+        # line -> set of rule names suppressed there
+        self.pragmas: dict[int, set[str]] = {}
+        self.bad_pragma_lines: list[int] = []
+        self._index_pragmas()
+
+    def _index_pragmas(self) -> None:
+        for lineno, line in enumerate(self.lines, 1):
+            m = PRAGMA_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")
+                     if r.strip()}
+            reason = (m.group(2) or "").strip()
+            if not rules or not reason:
+                self.bad_pragma_lines.append(lineno)
+                continue
+            target = lineno
+            if line.lstrip().startswith("#"):
+                # comment-only pragma line covers the next line too
+                self.pragmas.setdefault(lineno + 1, set()).update(rules)
+            self.pragmas.setdefault(target, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return rule in self.pragmas.get(line, ())
+
+
+class Rule:
+    """Base rule: override ``check_file`` and/or ``finalize``."""
+
+    name = "rule"
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        return ()
+
+
+def _default_rules() -> list[Rule]:
+    from tools.hpnnlint.rules import all_rules
+
+    return all_rules()
+
+
+def iter_py_files(root: str, paths: list[str]) -> list[str]:
+    """Repo-relative .py files under the given relative paths."""
+    out: list[str] = []
+    for path in paths:
+        full = os.path.join(root, path)
+        if os.path.isfile(full):
+            out.append(path)
+            continue
+        for dirpath, dirs, files in os.walk(full):
+            dirs[:] = sorted(d for d in dirs if d not in SKIP_DIRS)
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    return sorted(set(out))
+
+
+def run(root: str, paths: list[str],
+        rules: list[Rule] | None = None) -> tuple[list[Finding], int]:
+    """Lint ``paths`` (repo-relative) under ``root``; returns
+    (findings, files_linted).  Findings are pragma-filtered and
+    sorted (file, line, rule)."""
+    if rules is None:
+        rules = _default_rules()
+    ctxs: dict[str, FileCtx] = {}
+    findings: list[Finding] = []
+    files = iter_py_files(root, paths)
+    for rel in files:
+        full = os.path.join(root, rel)
+        try:
+            with open(full, encoding="utf-8") as fp:
+                text = fp.read()
+            tree = ast.parse(text, filename=rel)
+        except (OSError, SyntaxError) as exc:
+            findings.append(Finding("parse", rel, 1,
+                                    f"cannot lint: {exc}"))
+            continue
+        ctx = FileCtx(root, rel, text, tree)
+        ctxs[rel] = ctx
+        for lineno in ctx.bad_pragma_lines:
+            findings.append(Finding(
+                "pragma", rel, lineno,
+                "pragma without a reason — write "
+                "'# hpnnlint: ignore[rule] -- why'"))
+        for rule in rules:
+            findings.extend(rule.check_file(ctx))
+    for rule in rules:
+        findings.extend(rule.finalize(root))
+    kept = []
+    for f in findings:
+        ctx = ctxs.get(f.file)
+        if (f.rule not in ("pragma", "parse") and ctx is not None
+                and ctx.suppressed(f.rule, f.line)):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.file, f.line, f.rule))
+    return kept, len(files)
+
+
+def to_json(findings: list[Finding], n_files: int) -> dict:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "version": 1,
+        "files": n_files,
+        "findings": [f._asdict() for f in findings],
+        "counts": counts,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hpnnlint",
+        description="repo-native static analysis (docs/analysis.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="repo-relative dirs/files (default: "
+                         "hpnn_tpu tools)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto from this file)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only the named rule(s)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as exc:
+        return 2 if exc.code not in (0, None) else 0
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    paths = args.paths or ["hpnn_tpu", "tools"]
+    rules = _default_rules()
+    if args.rule:
+        known = {r.name for r in rules}
+        bad = set(args.rule) - known
+        if bad:
+            print(f"hpnnlint: unknown rule(s) {sorted(bad)} "
+                  f"(have {sorted(known)})", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in set(args.rule)]
+    try:
+        findings, n_files = run(root, paths, rules)
+    except Exception as exc:  # an engine crash is exit 2, not "clean"
+        print(f"hpnnlint: internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(to_json(findings, n_files), indent=2,
+                         sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"hpnnlint: {len(findings)} finding(s) over "
+              f"{n_files} file(s)")
+    return 1 if findings else 0

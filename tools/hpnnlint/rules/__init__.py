@@ -1,0 +1,19 @@
+"""Rule registry for hpnnlint — one module per rule."""
+
+from __future__ import annotations
+
+from tools.hpnnlint.rules.knob_registry import KnobRegistryRule
+from tools.hpnnlint.rules.lock_discipline import LockDisciplineRule
+from tools.hpnnlint.rules.obs_catalog import ObsCatalogRule
+from tools.hpnnlint.rules.swallow import SwallowRule
+from tools.hpnnlint.rules.trace_purity import TracePurityRule
+
+
+def all_rules():
+    return [
+        ObsCatalogRule(),
+        KnobRegistryRule(),
+        LockDisciplineRule(),
+        SwallowRule(),
+        TracePurityRule(),
+    ]

@@ -1,0 +1,166 @@
+"""knob-registry: every ``HPNN_*`` knob is declared, documented, read.
+
+The central table is ``hpnn_tpu.config.KNOBS`` — a pure-literal dict
+(``{"HPNN_X": {"default": ..., "doc": "docs/page.md", "desc": ...}}``)
+so this rule can ``ast.literal_eval`` it without importing jax.
+
+Checks:
+* every knob-name string literal in linted source is a KNOBS key;
+* every KNOBS entry has ``default``/``doc``/``desc``, its doc page
+  exists, that page actually mentions the knob, and some source file
+  still reads it;
+* every ``HPNN_*`` token in the doc pages is a declared knob
+  (``HPNN_FAMILY_*`` wildcards cover the family).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from tools.hpnnlint.engine import FileCtx, Finding, Rule
+from tools.hpnnlint.rules.base import str_const
+
+KNOB_RE = re.compile(r"HPNN_[A-Z][A-Z0-9_]*")
+CONFIG_REL = os.path.join("hpnn_tpu", "config.py")
+DOC_PAGES = ("docs/observability.md", "docs/serving.md",
+             "docs/fleet.md", "docs/online.md", "docs/resilience.md",
+             "docs/performance.md", "docs/analysis.md",
+             "docs/api.md")
+REQUIRED_KEYS = ("default", "doc", "desc")
+
+
+class KnobRegistryRule(Rule):
+    name = "knob-registry"
+
+    def __init__(self) -> None:
+        # knob -> first (file, line) that reads it
+        self.used: dict[str, tuple[str, int]] = {}
+        self.table: dict | None = None
+        self.table_line = 1
+        self.table_err: str | None = None
+        self.saw_config = False
+
+    def _load_table(self, ctx: FileCtx) -> None:
+        self.saw_config = True
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if "KNOBS" not in names:
+                continue
+            self.table_line = node.lineno
+            try:
+                self.table = ast.literal_eval(node.value)
+            except ValueError:
+                self.table_err = ("KNOBS must be a pure literal dict "
+                                  "(ast.literal_eval-able)")
+            return node.lineno, node.end_lineno
+        self.table_err = "no `KNOBS = {...}` assignment found"
+        return None
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Finding]:
+        skip_span = None
+        if ctx.rel == CONFIG_REL:
+            skip_span = self._load_table(ctx)
+        for node in ast.walk(ctx.tree):
+            s = str_const(node)
+            if s is None or not KNOB_RE.fullmatch(s):
+                continue
+            if (skip_span
+                    and skip_span[0] <= node.lineno <= skip_span[1]):
+                continue  # the declaration itself is not a use
+            self.used.setdefault(s, (ctx.rel, node.lineno))
+        return ()
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        out: list[Finding] = []
+        if not self.saw_config:
+            return out  # fixture tree without a config module
+        if self.table is None:
+            out.append(Finding(
+                self.name, CONFIG_REL, self.table_line,
+                self.table_err or "KNOBS table unreadable"))
+            return out
+        declared = set(self.table)
+        for knob in sorted(self.used):
+            if knob not in declared:
+                rel, lineno = self.used[knob]
+                out.append(Finding(
+                    self.name, rel, lineno,
+                    f"knob `{knob}` is read here but not declared in "
+                    "hpnn_tpu.config.KNOBS — add a row (default, "
+                    "doc page, description)"))
+        for knob in sorted(declared):
+            entry = self.table[knob]
+            if (not isinstance(entry, dict)
+                    or any(k not in entry for k in REQUIRED_KEYS)):
+                out.append(Finding(
+                    self.name, CONFIG_REL, self.table_line,
+                    f"KNOBS[{knob!r}] must be a dict with keys "
+                    f"{REQUIRED_KEYS}"))
+                continue
+            if knob not in self.used:
+                # a knob read outside the lint scope (bench.py, the
+                # test harness) declares its reader explicitly, and
+                # we verify the claim against that file's text
+                reader = entry.get("reader")
+                ok = False
+                if reader:
+                    try:
+                        with open(os.path.join(root, reader),
+                                  encoding="utf-8") as fp:
+                            ok = knob in fp.read()
+                    except OSError:
+                        ok = False
+                if not ok:
+                    out.append(Finding(
+                        self.name, CONFIG_REL, self.table_line,
+                        f"KNOBS declares `{knob}` but no linted "
+                        "source (nor its declared 'reader' file) "
+                        "reads it — retire the row"))
+            page = entry["doc"]
+            path = os.path.join(root, page)
+            if not os.path.isfile(path):
+                out.append(Finding(
+                    self.name, CONFIG_REL, self.table_line,
+                    f"KNOBS[{knob!r}] points at missing doc page "
+                    f"{page!r}"))
+                continue
+            with open(path, encoding="utf-8") as fp:
+                text = fp.read()
+            hits = set(KNOB_RE.findall(text))
+            fams = {h for h in hits
+                    if text.count(h + "*")}  # HPNN_FAM_* wildcard
+            if knob not in hits and not any(
+                    knob.startswith(f) for f in fams):
+                out.append(Finding(
+                    self.name, CONFIG_REL, self.table_line,
+                    f"KNOBS[{knob!r}] names {page!r} as its doc page "
+                    "but the page never mentions the knob"))
+        for page in DOC_PAGES:
+            try:
+                with open(os.path.join(root, page),
+                          encoding="utf-8") as fp:
+                    lines = fp.read().splitlines()
+            except OSError:
+                continue
+            for lineno, line in enumerate(lines, 1):
+                for m in KNOB_RE.finditer(line):
+                    tok = m.group(0)
+                    rest = line[m.end():]
+                    if rest.startswith("*") or tok.endswith("_"):
+                        fam = tok.rstrip("_")
+                        if any(d.startswith(fam) for d in declared):
+                            continue
+                    elif tok in declared:
+                        continue
+                    out.append(Finding(
+                        self.name, page, lineno,
+                        f"docs mention `{tok}` but it is not in "
+                        "hpnn_tpu.config.KNOBS — declare it or drop "
+                        "the stale mention"))
+        return out

@@ -1,0 +1,266 @@
+"""lock-discipline: annotated fields only change under their lock.
+
+A class declares its locking contract inline, in ``__init__``::
+
+    self._lock = threading.Lock()
+    self._cool: dict[int, float] = {}   # guarded: _cool_lock
+
+Every later write to an annotated field — plain assignment, augmented
+assignment, item store, ``del``, or a mutating method call
+(``append``/``pop``/``update``/...) — must sit inside a
+``with self.<lock>`` block.  ``threading.Condition(self._lock)``
+aliases are understood: holding the condition holds the lock.
+
+Separately, any bare ``<x>.acquire()`` is flagged unless the matching
+``release()`` is in a ``finally`` (same statement list or an
+enclosing try), or the enclosing function is itself a lock-protocol
+method (``acquire``/``release``/``__enter__``/``__exit__``/
+``_is_owned`` — the lockwatch wrapper delegates there).
+
+``__init__`` writes are exempt: no other thread can hold a reference
+yet.  The runtime complement is hpnn_tpu/obs/lockwatch.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tools.hpnnlint.engine import FileCtx, Finding, Rule
+from tools.hpnnlint.rules.base import dotted, terminal
+
+GUARD_RE = re.compile(r"#\s*guarded:\s*(\w+)")
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore", "lock"}
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+            "update", "setdefault", "discard", "add", "popleft",
+            "appendleft", "sort"}
+LOCK_PROTOCOL_FUNCS = {"acquire", "release", "__enter__", "__exit__",
+                       "_is_owned", "locked"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``self.<attr>`` -> attr name, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self) -> None:
+        self.guards: dict[str, tuple[str, int]] = {}  # field->(lock,ln)
+        self.locks: set[str] = set()
+        self.alias: dict[str, str] = {}  # condition attr -> lock attr
+
+
+def _scan_init(cls: ast.ClassDef, ctx: FileCtx) -> _ClassInfo:
+    info = _ClassInfo()
+    init = next((n for n in cls.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "__init__"), None)
+    if init is None:
+        return info
+    for stmt in ast.walk(init):
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        attrs = [a for a in map(_self_attr, targets) if a]
+        if not attrs:
+            continue
+        value = stmt.value
+        if isinstance(value, ast.Call):
+            fn = terminal(value.func)
+            if fn in LOCK_CTORS:
+                info.locks.update(attrs)
+                if fn == "Condition" and value.args:
+                    under = _self_attr(value.args[0])
+                    if under:
+                        for a in attrs:
+                            info.alias[a] = under
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for lineno in range(stmt.lineno, end + 1):
+            if lineno > len(ctx.lines):
+                break
+            m = GUARD_RE.search(ctx.lines[lineno - 1])
+            if m:
+                for a in attrs:
+                    info.guards[a] = (m.group(1), lineno)
+                break
+    return info
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            info = _scan_init(cls, ctx)
+            for field, (lock, lineno) in sorted(info.guards.items()):
+                if lock not in info.locks and lock not in info.alias:
+                    out.append(Finding(
+                        self.name, ctx.rel, lineno,
+                        f"`# guarded: {lock}` on self.{field} names "
+                        "a lock never constructed in __init__ — "
+                        "typo?"))
+            if info.guards:
+                for meth in cls.body:
+                    if (isinstance(meth, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and meth.name != "__init__"):
+                        self._check_method(ctx, info, meth, out)
+        self._check_acquire(ctx, out)
+        return out
+
+    # --- guarded-field writes -------------------------------------
+
+    def _canon(self, info: _ClassInfo, lock: str) -> str:
+        return info.alias.get(lock, lock)
+
+    def _held_from_with(self, info: _ClassInfo,
+                        node: ast.With) -> set[str]:
+        held: set[str] = set()
+        for item in node.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call):
+                ce = ce.func  # e.g. with self._lock: vs timer()(..)
+            attr = _self_attr(ce)
+            if attr:
+                held.add(self._canon(info, attr))
+        return held
+
+    def _check_method(self, ctx: FileCtx, info: _ClassInfo,
+                      meth: ast.AST, out: list[Finding]) -> None:
+        rule = self
+
+        def written_fields(stmt: ast.stmt) -> list[tuple[str, int]]:
+            hits: list[tuple[str, int]] = []
+
+            def tgt(node: ast.AST) -> None:
+                if isinstance(node, (ast.Tuple, ast.List)):
+                    for elt in node.elts:
+                        tgt(elt)
+                    return
+                base = node
+                if isinstance(node, ast.Subscript):
+                    base = node.value
+                attr = _self_attr(base)
+                if attr and attr in info.guards:
+                    hits.append((attr, node.lineno))
+
+            if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (stmt.targets
+                           if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    tgt(t)
+            elif isinstance(stmt, ast.Delete):
+                for t in stmt.targets:
+                    tgt(t)
+            elif isinstance(stmt, ast.Expr):
+                call = stmt.value
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr in MUTATORS):
+                    attr = _self_attr(call.func.value)
+                    if attr and attr in info.guards:
+                        hits.append((attr, stmt.lineno))
+            return hits
+
+        def visit(stmts: list[ast.stmt], held: frozenset[str]) -> None:
+            for stmt in stmts:
+                for field, lineno in written_fields(stmt):
+                    lock = info.guards[field][0]
+                    if rule._canon(info, lock) not in held:
+                        out.append(Finding(
+                            rule.name, ctx.rel, lineno,
+                            f"self.{field} is `# guarded: {lock}` "
+                            f"but written here outside "
+                            f"`with self.{lock}`"))
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    now = held | rule._held_from_with(info, stmt)
+                    visit(stmt.body, frozenset(now))
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    # a closure may run on another thread — it must
+                    # take the lock itself
+                    visit(stmt.body, frozenset())
+                else:
+                    for block in ("body", "orelse", "finalbody",
+                                  "handlers"):
+                        sub = getattr(stmt, block, None)
+                        if not sub:
+                            continue
+                        if block == "handlers":
+                            for h in sub:
+                                visit(h.body, held)
+                        else:
+                            visit(sub, held)
+
+        visit(meth.body, frozenset())
+
+    # --- bare .acquire() ------------------------------------------
+
+    def _check_acquire(self, ctx: FileCtx,
+                       out: list[Finding]) -> None:
+        def released_in(finalbody: list[ast.stmt]) -> set[str]:
+            rel: set[str] = set()
+            for node in finalbody:
+                for call in ast.walk(node):
+                    if (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Attribute)
+                            and call.func.attr == "release"):
+                        chain = dotted(call.func.value)
+                        if chain:
+                            rel.add(chain)
+            return rel
+
+        def acquire_chain(stmt: ast.stmt) -> str | None:
+            value = getattr(stmt, "value", None)
+            if (isinstance(stmt, (ast.Expr, ast.Assign))
+                    and isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr == "acquire"):
+                return dotted(value.func.value) or "<expr>"
+            return None
+
+        def visit(stmts: list[ast.stmt], ok: frozenset[str],
+                  fname: str) -> None:
+            for i, stmt in enumerate(stmts):
+                chain = acquire_chain(stmt)
+                if chain is not None and fname not in \
+                        LOCK_PROTOCOL_FUNCS:
+                    nxt = stmts[i + 1] if i + 1 < len(stmts) else None
+                    follows = (isinstance(nxt, ast.Try)
+                               and chain in released_in(nxt.finalbody))
+                    if chain not in ok and not follows:
+                        out.append(Finding(
+                            self.name, ctx.rel, stmt.lineno,
+                            f"bare {chain}.acquire() without a "
+                            "try/finally release — use `with` (or "
+                            "obs.lockwatch.lock for named locks)"))
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    visit(stmt.body, frozenset(), stmt.name)
+                    continue
+                now = ok
+                if isinstance(stmt, ast.Try):
+                    now = ok | released_in(stmt.finalbody)
+                for block in ("body", "orelse", "finalbody",
+                              "handlers"):
+                    sub = getattr(stmt, block, None)
+                    if not sub:
+                        continue
+                    if block == "handlers":
+                        for h in sub:
+                            visit(h.body, now, fname)
+                    else:
+                        visit(sub, now, fname)
+
+        visit(ctx.tree.body, frozenset(), "<module>")

@@ -1,0 +1,143 @@
+"""obs-catalog: event names and the docs catalog agree, both ways.
+
+Forward (same contract as tools/check_obs_catalog.py, here as an AST
+pass): every literal event name handed to ``event/count/gauge/
+observe/timer`` or ``spans.start`` in ``hpnn_tpu/``, and every raw
+``{"ev": ...}`` record, must be documented in a catalog page
+(wildcard ``family.*`` rows cover the family).
+
+Reverse (new): every catalog *table row* — lines shaped
+``| `name` | kind | ...`` with kind in event/count/gauge/timer/hist/
+span/summary — must name an event the source can still emit.  A name
+counts as emittable when it appears as a string literal anywhere in
+``hpnn_tpu/`` (raw records and registries included) or extends a
+literal dotted prefix (f-strings / concatenation build the tail).
+Only table rows are held to this — prose may mention retired names
+while explaining history.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from tools.hpnnlint.engine import FileCtx, Finding, Rule
+from tools.hpnnlint.rules.base import dotted, str_const, terminal
+
+EMIT_FUNCS = {"event", "count", "gauge", "observe", "timer"}
+NAME_RE = re.compile(r"[a-z0-9_]+(?:\.[a-z0-9_]+)+")
+DOC_RE = re.compile(r"`([a-z0-9_]+(?:\.(?:[a-z0-9_]+|\*))+)`")
+ROW_RE = re.compile(
+    r"^\|\s*`([a-z0-9_]+(?:\.(?:[a-z0-9_]+|\*))+)`\s*\|\s*"
+    r"(event|count|gauge|timer|hist|span|summary)\s*\|")
+
+DOC_PAGES = ("docs/observability.md", "docs/serving.md",
+             "docs/fleet.md", "docs/online.md", "docs/resilience.md",
+             "docs/performance.md", "docs/analysis.md")
+
+
+def _covered(name: str, documented: set[str]) -> bool:
+    if name in documented:
+        return True
+    parts = name.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        if ".".join(parts[:i]) + ".*" in documented:
+            return True
+    return False
+
+
+class ObsCatalogRule(Rule):
+    name = "obs-catalog"
+
+    def __init__(self) -> None:
+        # emitted event name -> first (file, line) seen
+        self.emitted: dict[str, tuple[str, int]] = {}
+        # every dotted-name string literal in hpnn_tpu (evidence that
+        # a documented name is still reachable, e.g. via raw records)
+        self.literals: set[str] = set()
+        # literal dotted prefixes ("serve.", f-string heads) — a
+        # documented name extending one counts as dynamically built
+        self.prefixes: set[str] = set()
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Finding]:
+        if not ctx.rel.startswith("hpnn_tpu" + os.sep):
+            return ()
+        for node in ast.walk(ctx.tree):
+            s = str_const(node)
+            if s is not None:
+                if NAME_RE.fullmatch(s):
+                    self.literals.add(s)
+                elif (s.endswith(".")
+                        and NAME_RE.fullmatch(s + "x")):
+                    self.prefixes.add(s)
+            if isinstance(node, ast.JoinedStr) and node.values:
+                head = str_const(node.values[0])
+                if head and "." in head:
+                    self.prefixes.add(head)
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    ev = str_const(v)
+                    if (k is not None and str_const(k) == "ev"
+                            and ev and NAME_RE.fullmatch(ev)):
+                        self.emitted.setdefault(
+                            ev, (ctx.rel, node.lineno))
+            if not isinstance(node, ast.Call):
+                continue
+            fn = terminal(node.func)
+            chain = dotted(node.func) or ""
+            is_emit = fn in EMIT_FUNCS or chain.endswith("spans.start")
+            if not is_emit or not node.args:
+                continue
+            ev = str_const(node.args[0])
+            if ev and NAME_RE.fullmatch(ev):
+                self.emitted.setdefault(ev, (ctx.rel, node.lineno))
+        return ()
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        documented: set[str] = set()
+        rows: list[tuple[str, str, int]] = []  # (name, page, line)
+        pages_seen = 0
+        for page in DOC_PAGES:
+            try:
+                with open(os.path.join(root, page),
+                          encoding="utf-8") as fp:
+                    lines = fp.read().splitlines()
+            except OSError:
+                continue
+            pages_seen += 1
+            for lineno, line in enumerate(lines, 1):
+                documented.update(DOC_RE.findall(line))
+                m = ROW_RE.match(line)
+                if m:
+                    rows.append((m.group(1), page, lineno))
+        out: list[Finding] = []
+        if not self.emitted or not pages_seen:
+            # only meaningful when linting the real tree; a fixture
+            # tree without obs calls or docs is vacuously fine
+            return out
+        for ev in sorted(self.emitted):
+            if not _covered(ev, documented):
+                rel, lineno = self.emitted[ev]
+                out.append(Finding(
+                    self.name, rel, lineno,
+                    f"event `{ev}` is emitted here but missing from "
+                    f"the docs catalog ({', '.join(DOC_PAGES[:1])} "
+                    "et al.) — add a catalog row"))
+        evidence = self.literals | set(self.emitted)
+        for name, page, lineno in rows:
+            if name.endswith(".*"):
+                fam = name[:-1]
+                if any(e.startswith(fam) for e in evidence):
+                    continue
+            elif name in evidence:
+                continue
+            elif any(name.startswith(p) for p in self.prefixes):
+                continue
+            out.append(Finding(
+                self.name, page, lineno,
+                f"catalog row documents `{name}` but no emission "
+                "site in hpnn_tpu/ can produce it — retire the row "
+                "or restore the emitter"))
+        return out

@@ -1,0 +1,57 @@
+"""swallow: no silent broad excepts.
+
+``except Exception: pass`` (or bare ``except:``) hides real failures
+behind best-effort cleanup.  A broad handler must do at least one of:
+
+* narrow the type (``except (OSError, ValueError):``),
+* do *something* observable — emit an obs count, log, re-raise —
+  i.e. contain any call or ``raise``,
+* carry ``# hpnnlint: ignore[swallow] -- reason`` explaining why
+  silence is correct (crash paths, interpreter teardown).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.hpnnlint.engine import FileCtx, Finding, Rule
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.AST | None) -> bool:
+    if type_node is None:
+        return True  # bare except:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Call, ast.Raise)):
+                return False
+    return True
+
+
+class SwallowRule(Rule):
+    name = "swallow"
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type) and _is_silent(node.body):
+                out.append(Finding(
+                    self.name, ctx.rel, node.lineno,
+                    "broad except swallows silently — narrow the "
+                    "type, emit an obs count, or pragma with a "
+                    "reason"))
+        return out

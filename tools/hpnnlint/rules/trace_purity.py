@@ -1,0 +1,136 @@
+"""trace-purity: no host effects inside traced functions.
+
+A function handed to ``jit``/``vmap``/``scan``/``pallas_call`` (or
+decorated with one) runs under tracing: host reads like
+``time.time()``, ``os.environ``, ``np.random`` and device syncs like
+``.item()`` either burn into the compiled artifact as stale
+constants or silently destroy async dispatch.  This rule finds the
+traced roots in each file — decorator form, call form, and the
+control-flow primitives (``scan``/``cond``/``while_loop``/
+``fori_loop``/``switch``) — and scans each root plus every same-file
+function it directly calls (one call-graph hop) for impure sites.
+
+Trace-time-constant reads that are genuinely intended (a debug knob
+burned in at compile time) carry
+``# hpnnlint: ignore[trace-purity] -- reason``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.hpnnlint.engine import FileCtx, Finding, Rule
+from tools.hpnnlint.rules.base import dotted, terminal
+
+TRACE_DECOS = {"jit", "vmap", "pmap", "remat", "checkpoint",
+               "custom_jvp", "custom_vjp"}
+TRACE_CALLS = TRACE_DECOS | {"grad", "value_and_grad", "scan",
+                             "fori_loop", "while_loop", "cond",
+                             "switch", "shard_map", "pallas_call"}
+IMPURE_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                "time.time_ns", "time.sleep", "os.getenv",
+                "os.urandom"}
+IMPURE_HEADS = {"os.environ", "np.random", "numpy.random"}
+
+
+def _impurities(fn: ast.AST) -> list[tuple[int, str]]:
+    out: set[tuple[int, str]] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = dotted(node.func)
+            if chain in IMPURE_CALLS:
+                out.add((node.lineno, f"{chain}()"))
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                out.add((node.lineno, ".item() host sync"))
+        elif isinstance(node, ast.Attribute):
+            chain = dotted(node)
+            if chain:
+                head = ".".join(chain.split(".")[:2])
+                if head in IMPURE_HEADS:
+                    out.add((node.lineno, head))
+    return sorted(out)
+
+
+def _is_traced_deco(deco: ast.AST) -> bool:
+    if isinstance(deco, ast.Call):
+        fn = terminal(deco.func)
+        if fn in TRACE_DECOS:
+            return True
+        if fn == "partial" and deco.args:
+            return terminal(deco.args[0]) in TRACE_DECOS
+        return False
+    return terminal(deco) in TRACE_DECOS
+
+
+class TracePurityRule(Rule):
+    name = "trace-purity"
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Finding]:
+        module_funcs: dict[str, ast.AST] = {
+            n.name: n for n in ctx.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        methods: dict[str, list[ast.AST]] = {}
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                for n in cls.body:
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        methods.setdefault(n.name, []).append(n)
+
+        def resolve(node: ast.AST) -> tuple[str, ast.AST] | None:
+            """A callable expression -> (label, FunctionDef/Lambda)."""
+            if isinstance(node, ast.Lambda):
+                return "<lambda>", node
+            if isinstance(node, ast.Name):
+                fn = module_funcs.get(node.id)
+                return (node.id, fn) if fn is not None else None
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                cands = methods.get(node.attr, [])
+                if len(cands) == 1:  # ambiguous across classes: skip
+                    return "self." + node.attr, cands[0]
+            return None
+
+        roots: dict[int, tuple[str, ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                if any(_is_traced_deco(d) for d in node.decorator_list):
+                    roots.setdefault(id(node), (node.name, node))
+            elif (isinstance(node, ast.Call)
+                    and terminal(node.func) in TRACE_CALLS):
+                for arg in node.args:
+                    hit = resolve(arg)
+                    if hit is not None:
+                        roots.setdefault(id(hit[1]), hit)
+
+        out: list[Finding] = []
+        seen: set[tuple[int, str]] = set()
+
+        def report(lineno: int, desc: str, label: str,
+                   via: str | None) -> None:
+            if (lineno, desc) in seen:
+                return
+            seen.add((lineno, desc))
+            path = (f"traced `{label}` (via `{via}`)"
+                    if via else f"traced `{label}`")
+            out.append(Finding(
+                self.name, ctx.rel, lineno,
+                f"host-impure {desc} reachable inside {path} — "
+                "hoist it out of the traced region"))
+
+        for label, fn in roots.values():
+            for lineno, desc in _impurities(fn):
+                report(lineno, desc, label, None)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = resolve(node.func)
+                if callee is None or id(callee[1]) in roots:
+                    continue
+                for lineno, desc in _impurities(callee[1]):
+                    report(lineno, desc, label, callee[0])
+        return out
