@@ -635,6 +635,94 @@ def bench_collector_overhead(repeats: int = 5):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_sampler_overhead(iters: int = 200, repeats: int = 5):
+    """Paired measurement of the tail sampler's MARGINAL cost on the
+    serve hot path: the same ``Session.infer`` loop with the JSONL
+    sink armed in BOTH legs, plus — in the "on" leg only —
+    ``HPNN_SAMPLE=1`` (every request minted and span-recorded, the
+    worst case; production rates are 0.01–0.05).  Quantifies the
+    claim that always-on tail sampling is affordable
+    (docs/observability.md "Forensics"; tools/bench_gate.py gates
+    ``sampler_overhead_pct``)."""
+    from hpnn_tpu import obs, serve
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    prev_sink = obs.sink_path() if obs.enabled() else None
+    d = tempfile.mkdtemp(prefix="hpnn_sampler_bench_")
+    saved = os.environ.pop("HPNN_SAMPLE", None)
+
+    def arm(on: bool, sink: str) -> None:
+        # obs.configure re-runs the reset chain, so the sampler memo
+        # re-reads HPNN_SAMPLE on the next request
+        if on:
+            os.environ["HPNN_SAMPLE"] = "1"
+        else:
+            os.environ.pop("HPNN_SAMPLE", None)
+        obs.configure(sink)
+
+    n_in, n_hid, n_out = FLEET_SHAPE
+    kern = kernel_mod.generate(4242, n_in, [n_hid], n_out)[0]
+    x = np.random.RandomState(2).normal(size=n_in)
+    sess = None
+    try:
+        sess = serve.Session(max_batch=8, n_buckets=2,
+                             max_wait_ms=0.5)
+        sess.register_kernel("bench", kern)
+
+        # warm both legs (compile, sink open, sampler memo)
+        arm(False, os.path.join(d, "warm_off.jsonl"))
+        for _ in range(10):
+            sess.infer("bench", x)
+        arm(True, os.path.join(d, "warm_on.jsonl"))
+        for _ in range(10):
+            sess.infer("bench", x)
+
+        on_s, off_s = [], []
+        for r in range(repeats):
+            arm(False, os.path.join(d, f"off{r}.jsonl"))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                sess.infer("bench", x)
+            off_s.append(time.perf_counter() - t0)
+            arm(True, os.path.join(d, f"on{r}.jsonl"))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                sess.infer("bench", x)
+            on_s.append(time.perf_counter() - t0)
+        obs.configure(None)  # close the last sink so the count below
+        # is over flushed bytes
+
+        # the proof the "on" leg actually sampled: every request of
+        # the last on-leg must have minted a serve.request span
+        sampled = 0
+        with open(os.path.join(d, f"on{repeats - 1}.jsonl")) as fp:
+            for ln in fp:
+                sampled += ('"span.end"' in ln
+                            and '"serve.request"' in ln)
+        deltas = [round(100.0 * (a - b) / b, 2)
+                  for a, b in zip(on_s, off_s)]
+        return {
+            "iters": iters,
+            "infer_s_sampler_off": _stats([round(v, 4) for v in off_s]),
+            "infer_s_sampler_on": _stats([round(v, 4) for v in on_s]),
+            "paired_overhead_pct": {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            },
+            "sampled_requests_last_round": sampled,
+        }
+    finally:
+        if sess is not None:
+            sess.close()
+        obs.configure(None)
+        if saved is None:
+            os.environ.pop("HPNN_SAMPLE", None)
+        else:
+            os.environ["HPNN_SAMPLE"] = saved
+        obs.configure(prev_sink)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 FLEET_MEMBERS = 64
 FLEET_SHAPE = (32, 16, 4)   # HPNN-sized: the paper's natural workload
 FLEET_TICKS = 30
@@ -1015,6 +1103,15 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["collector_overhead_error"] = repr(exc)
 
+    # tail-sampler overhead: the same paired shape on the SERVE hot
+    # path, HPNN_SAMPLE=1 in one leg (docs/observability.md
+    # "Forensics") — rides the same skip knob, best-effort
+    if not os.environ.get("HPNN_BENCH_NO_OBS_OVERHEAD"):
+        try:
+            out["sampler_overhead"] = bench_sampler_overhead()
+        except Exception as exc:
+            out["sampler_overhead_error"] = repr(exc)
+
     # HPNN_METRICS: the bench subprocesses/rounds inherit the knob, so
     # the run's structured events land in the sink — record where, and
     # fold obs_report's machine summary in (best-effort: a torn sink
@@ -1186,6 +1283,23 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["worker_drill_error"] = repr(exc)
 
+    # Capsule drill (tools/chaos_drill.py run_bench_capsule_drill):
+    # inject a deterministic delay at the serve.dispatch seam under
+    # sampled load with an slo.p99_ms alert armed, prove the alert
+    # fires, the capture capsule lands (spans + profiler window), and
+    # tools/tail_report.py blames the dispatch phase for the tail
+    # (docs/observability.md "Tail-latency forensics").  Rides the
+    # same HPNN_BENCH_NO_DRILL knob (in-process, a few seconds).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["capsule_drill"] = chaos_drill.run_bench_capsule_drill()
+        except Exception as exc:
+            out["capsule_drill_error"] = repr(exc)
+
     # Autoscale ramp (tools/bench_autoscale.py): a loadgen ramp past
     # the single-worker plateau that the SLO-driven autoscaler rides —
     # width 1→N under overdrive, windowed goodput vs the plateau,
@@ -1314,6 +1428,11 @@ def main(argv=None) -> None:
         wd = out["worker_drill"]
         compact["drill_worker_dip_pct"] = wd["goodput_dip_pct"]
         compact["drill_worker_replaced_s"] = wd["replaced_s"]
+    if ("capsule_drill" in out
+            and out["capsule_drill"].get("capture_s") is not None):
+        cd = out["capsule_drill"]
+        compact["drill_capsule_capture_s"] = cd["capture_s"]
+        compact["drill_capsule_blame_pct"] = cd["dispatch_blame_pct"]
     if ("autoscale" in out
             and out["autoscale"].get("goodput_x") is not None):
         asc = out["autoscale"]
@@ -1328,6 +1447,10 @@ def main(argv=None) -> None:
     if "collector_overhead" in out:
         compact["collector_overhead_pct"] = (
             out["collector_overhead"]["paired_overhead_pct"]["median"]
+        )
+    if "sampler_overhead" in out:
+        compact["sampler_overhead_pct"] = (
+            out["sampler_overhead"]["paired_overhead_pct"]["median"]
         )
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:

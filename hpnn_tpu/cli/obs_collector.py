@@ -10,6 +10,12 @@ with no reference counterpart:
 
     obs_collector [--port N] [--host H] [--out PATH] [--queue N]
                   [--scrape URL[,URL...]] [--interval S]
+                  [--capsule-dir DIR]
+
+``--capsule-dir`` (the CLI twin of ``HPNN_CAPSULE_DIR``) arms capture
+capsules on the collector process itself: ``POST /v1/capture`` snaps
+the fleet view — merged aggregates, recv census — into a capsule
+directory (obs/triggers.py; docs/observability.md "Forensics").
 
 ``--scrape`` adds the pull half: the listed worker ``/metrics``
 endpoints are polled every ``--interval`` seconds (default 5) for
@@ -30,7 +36,8 @@ def main(argv: list[str] | None = None) -> int:
     common.install_sigpipe_handler()
     argv, opts = common.extract_long_opts(
         argv,
-        valued=("port", "host", "out", "queue", "scrape", "interval"),
+        valued=("port", "host", "out", "queue", "scrape", "interval",
+                "capsule-dir"),
     )
     if argv is None:
         return -1
@@ -55,6 +62,10 @@ def main(argv: list[str] | None = None) -> int:
 
     from hpnn_tpu.obs import collector
 
+    if "capsule-dir" in opts:
+        from hpnn_tpu import obs
+
+        obs.triggers.configure(opts["capsule-dir"])
     try:
         server = collector.start_collector(
             host=opts.get("host", "127.0.0.1"),

@@ -8,7 +8,13 @@ flag grammar stays the reference's; serving knobs are TPU-side long
 options:
 
     serve_nn [-v] [--port N] [--host H] [--max-batch N]
-             [--max-wait-ms F] [--metrics PATH] nn.conf
+             [--max-wait-ms F] [--metrics PATH] [--sample P]
+             [--capsule-dir DIR] nn.conf
+
+``--sample``/``--capsule-dir`` are the CLI twins of
+``HPNN_SAMPLE``/``HPNN_CAPSULE_DIR`` (tail-latency forensics,
+docs/observability.md): arm request sampling and alert/manual capture
+capsules without touching the environment.
 
 stdout stays silent (the token protocol belongs to train/run rounds);
 all serving diagnostics go to stderr.
@@ -51,11 +57,25 @@ def main(argv: list[str] | None = None) -> int:
     runtime.init_all(1)
     argv, opts = common.extract_long_opts(
         argv,
-        valued=("port", "host", "max-batch", "max-wait-ms", "metrics"),
+        valued=("port", "host", "max-batch", "max-wait-ms", "metrics",
+                "sample", "capsule-dir"),
     )
     if argv is None or not common.validate_long_opts(opts):
         runtime.deinit_all()
         return -1
+    if "sample" in opts or "capsule-dir" in opts:
+        from hpnn_tpu import obs
+
+        # twins must land BEFORE obs.configure so the registry's
+        # file-less activation + hook arming see them
+        if "sample" in opts:
+            obs.forensics.configure(opts["sample"])
+        if "capsule-dir" in opts:
+            obs.triggers.configure(opts["capsule-dir"])
+        if "metrics" not in opts:
+            import os
+
+            obs.configure(os.environ.get(obs.ENV_KNOB))
     if "metrics" in opts:
         from hpnn_tpu import obs
 

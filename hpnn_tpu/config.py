@@ -380,6 +380,25 @@ KNOBS = {
     "HPNN_ALERTS": {
         "default": None, "doc": "docs/observability.md",
         "desc": "alert rule grammar over the live gauge stream"},
+    # --- tail-latency forensics (docs/observability.md) ---
+    "HPNN_SAMPLE": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "tail sampling: request-span probability in (0, 1]"},
+    "HPNN_SAMPLE_SLOW_MS": {
+        "default": 0, "doc": "docs/observability.md",
+        "desc": "absolute slow-promotion floor in ms (0 = adaptive)"},
+    "HPNN_SAMPLE_RING": {
+        "default": 256, "doc": "docs/observability.md",
+        "desc": "sampler latency-ring capacity (floor 16)"},
+    "HPNN_CAPSULE_DIR": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "write alert/manual capture capsules under this dir"},
+    "HPNN_CAPSULE_PROFILE_MS": {
+        "default": 200, "doc": "docs/observability.md",
+        "desc": "capsule jax.profiler trace window in ms (0 = off)"},
+    "HPNN_CAPSULE_COOLDOWN_S": {
+        "default": 30, "doc": "docs/observability.md",
+        "desc": "minimum seconds between finished captures"},
     # --- chaos / durability (docs/resilience.md) ---
     "HPNN_CHAOS": {
         "default": None, "doc": "docs/resilience.md",

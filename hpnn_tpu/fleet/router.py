@@ -196,7 +196,9 @@ class ClusterRouter:
         if req_id is not None:
             rfields["req_id"] = req_id
         rfields.update(obs.propagate.fields(trace))
-        rspan = obs.spans.start("cluster.request", **rfields)
+        # real span under HPNN_SPANS, sampled/promotable under
+        # HPNN_SAMPLE, shared null span otherwise (obs/forensics.py)
+        rspan = obs.forensics.request_span("cluster.request", **rfields)
         sub = obs.propagate.ctx_from(
             rspan, trace=getattr(trace, "trace", None))
         t0 = self._clock()
@@ -214,7 +216,7 @@ class ClusterRouter:
                     with self._stat_lock:
                         self._routed += 1
                     obs.slo.record("ok", self._clock() - t0)
-                    obs.spans.finish(rspan, rank=h.rank)
+                    obs.forensics.finish(rspan, rank=h.rank)
                     return out
                 except Shed as exc:
                     self._cool_down(h.rank, exc.retry_after_s)
@@ -239,7 +241,7 @@ class ClusterRouter:
             raise Shed("no ready worker", reason="no_worker",
                        retry_after_s=1.0)
         except BaseException as exc:
-            obs.spans.finish(rspan, failed=type(exc).__name__)
+            obs.forensics.finish(rspan, failed=type(exc).__name__)
             raise
 
     def _ingest(self, kernel: str | None, inputs, targets) -> dict:
@@ -411,6 +413,8 @@ class ClusterRouter:
         doc["obs"] = obs.export.health()
         doc["slo"] = obs.slo.health_doc()
         doc["alerts"] = obs.alerts.health_doc()
+        doc["sampler"] = obs.forensics.health_doc()
+        doc["capsules"] = obs.triggers.health_doc()
         if self.online_health is not None:
             doc["online"] = self.online_health()
         return doc

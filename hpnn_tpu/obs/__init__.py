@@ -54,7 +54,17 @@ structured side channel next to it:
   acquisition-order graph, where a cycle is a latent deadlock and
   fails the armed test run with both acquisition stacks —
   ``HPNN_LOCKWATCH`` (obs/lockwatch.py; static twin:
-  ``tools/hpnnlint``, docs/analysis.md).
+  ``tools/hpnnlint``, docs/analysis.md);
+* the tail-latency forensics plane: always-on head sampling that
+  arms real request spans for a sampled fraction (plus adaptive
+  retro-promotion of slow outliers) without ``HPNN_SPANS`` —
+  ``HPNN_SAMPLE`` (obs/forensics.py) — trace-id exemplars on the
+  ``/metrics`` latency buckets (registry + obs/export.py), and
+  alert-triggered capture capsules bundling flight ring, sampled
+  spans, gauges, ``/healthz``, and a bounded programmatic
+  ``jax.profiler`` trace window — ``HPNN_CAPSULE_DIR``
+  (obs/triggers.py; slowest-N phase-blame analysis:
+  ``tools/tail_report.py``).
 
 Typical instrumentation site::
 
@@ -72,8 +82,8 @@ docs/analysis.md.
 """
 
 from hpnn_tpu.obs import (alerts, collector, cost, device, export,
-                          flight, ledger, lockwatch, probes,
-                          propagate, slo, spans)
+                          flight, forensics, ledger, lockwatch,
+                          probes, propagate, slo, spans, triggers)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -107,6 +117,7 @@ __all__ = [
     "export",
     "flight",
     "flush",
+    "forensics",
     "gauge",
     "ledger",
     "lockwatch",
@@ -120,4 +131,5 @@ __all__ = [
     "step_annotation",
     "summary",
     "timer",
+    "triggers",
 ]

@@ -62,6 +62,12 @@ from hpnn_tpu.obs import flight, registry
 
 ENV_KNOB = "HPNN_ALERTS"
 
+# fire-time fan-out hook (obs/triggers.py capture capsules) — same
+# shape as the registry's _push_hook: a module-level callable, one
+# ``is not None`` check per fire, armed by triggers._install and
+# disarmed by its reset.  Called with a copy of the fire record.
+_fire_hook = None
+
 DEFAULT_COOLDOWN_S = 30.0
 DEFAULT_ALPHA = 0.2
 DEFAULT_WARMUP = 10
@@ -150,6 +156,9 @@ class _Rule:
             if dump:
                 rec["flight"] = dump
             registry.event("alert.fire", **rec)
+            hook = _fire_hook
+            if hook is not None:
+                hook(dict(rec))  # capsule capture (obs/triggers.py)
         else:
             self.breach_since = None
             if not self.active:

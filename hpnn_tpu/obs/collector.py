@@ -26,7 +26,10 @@ both halves of the missing hop:
   ``GET /metrics`` (Prometheus) and ``GET /fleetz`` (JSON: per-worker
   health/staleness + fleet totals).  It can additionally **scrape**
   worker ``/metrics`` endpoints (``--scrape URL``) for liveness when
-  workers cannot push.
+  workers cannot push.  With ``HPNN_CAPSULE_DIR`` armed it also
+  answers ``POST /v1/capture`` — a manual forensic capsule of the
+  collector process (obs/triggers.py) — and ``/healthz`` carries the
+  capsule census.
 
 Batch wire format (``POST /v1/telemetry``, JSON)::
 
@@ -414,9 +417,10 @@ class Collector:
                 "records": self.records_total,
                 "recv_dropped": self.recv_dropped,
             }
-        from hpnn_tpu.obs import alerts
+        from hpnn_tpu.obs import alerts, triggers
 
         doc["alerts"] = alerts.health_doc()
+        doc["capsules"] = triggers.health_doc()
         return doc
 
     # -- scrape (pull) fallback ---------------------------------------
@@ -474,6 +478,21 @@ class _CollectorHandler(BaseHTTPRequestHandler):
                    "application/json")
 
     def do_POST(self):
+        if self.path == "/v1/capture":
+            # manual forensic capsule of the collector process itself
+            # (obs/triggers.py; HPNN_CAPSULE_DIR) — fleet aggregates
+            # and the recv census land in gauges.json/health.json
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                body = None
+            from hpnn_tpu.obs import triggers
+
+            code, payload = triggers.http_capture(
+                body if isinstance(body, dict) else None)
+            self._send_json(code, payload)
+            return
         if self.path != "/v1/telemetry":
             self._send_json(404, {"error": "not found"})
             return

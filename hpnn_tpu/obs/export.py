@@ -87,10 +87,14 @@ def _metric_name(ev: str) -> str:
 
 
 def _escape_label_value(v) -> str:
-    """Escape one label value per the exposition spec: backslash,
-    double-quote and newline are the only characters that need it."""
+    """Escape one label value: backslash, double-quote and newline
+    per the exposition spec, plus carriage return — a raw ``\\r``
+    inside a value breaks the line structure for any
+    ``splitlines()``-style reader (it splits on ``\\r`` too), so
+    exemplar trace-ids and free-form values must round-trip it
+    escaped (tests/test_perf_attr.py proves the round trip)."""
     return (str(v).replace("\\", "\\\\").replace('"', '\\"')
-            .replace("\n", "\\n"))
+            .replace("\n", "\\n").replace("\r", "\\r"))
 
 
 def _render_labels(labels: dict) -> str:
@@ -168,10 +172,29 @@ def render_prometheus(snap: dict | None) -> str:
         for q in QUANTILES:
             est = _quantile_estimate(agg, q)
             labels = _render_labels({"quantile": q})
-            lines.append(f"{m}{labels} {_fmt(est)}")
+            lines.append(f"{m}{labels} {_fmt(est)}"
+                         + _exemplar_suffix(agg, est))
         lines.append(f"{m}_sum {_fmt(agg['total'])}")
         lines.append(f"{m}_count {agg['n']}")
     return "\n".join(lines) + "\n"
+
+
+def _exemplar_suffix(agg: dict, est: float) -> str:
+    """The OpenMetrics-style exemplar suffix for one quantile line —
+    `` # {trace_id="..."} <value>`` — linking the bucket the estimate
+    lands in (or the nearest populated bucket below it) to the last
+    trace the tail sampler marked there (``registry.exemplar``).
+    Empty when the aggregate carries no exemplars."""
+    ex = agg.get("exemplars")
+    if not ex:
+        return ""
+    k = registry._bucket_of(est)
+    below = [int(b) for b in ex if int(b) <= k]
+    if not below:
+        return ""
+    e = ex[str(max(below))]
+    labels = _render_labels({"trace_id": e["trace_id"]})
+    return f" # {labels} {_fmt(e['value'])}"
 
 
 def metrics_body() -> bytes:

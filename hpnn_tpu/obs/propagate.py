@@ -60,8 +60,14 @@ _slots_lock = threading.Lock()
 
 
 def enabled() -> bool:
-    """Propagation rides the spans knob — no second env var."""
-    return spans.enabled()
+    """Propagation rides the spans knob — or the tail sampler
+    (``HPNN_SAMPLE``, obs/forensics.py), whose sampled requests need
+    trace ids on the wire just like fully-spanned ones."""
+    if spans.enabled():
+        return True
+    from hpnn_tpu.obs import forensics
+
+    return forensics.enabled()
 
 
 class Ctx:

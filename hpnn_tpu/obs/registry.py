@@ -89,7 +89,7 @@ class _Agg:
     """Running aggregate (count/sum/min/max + log2 buckets) for one
     timer or histogram name."""
 
-    __slots__ = ("n", "total", "vmin", "vmax", "buckets")
+    __slots__ = ("n", "total", "vmin", "vmax", "buckets", "exemplars")
 
     def __init__(self):
         self.n = 0
@@ -97,6 +97,14 @@ class _Agg:
         self.vmin = None
         self.vmax = None
         self.buckets: dict[int, int] = {}
+        # last (trace_id, value) per hot log2 bucket — rendered as
+        # OpenMetrics-style exemplars on /metrics (obs/export.py),
+        # marked by the tail sampler (obs/forensics.py).  Bounded by
+        # the bucket count; empty unless something marks it.
+        self.exemplars: dict[int, tuple[str, float]] = {}
+
+    def mark(self, v: float, trace: str) -> None:
+        self.exemplars[_bucket_of(v)] = (trace, v)
 
     def add(self, v: float) -> None:
         self.n += 1
@@ -128,7 +136,7 @@ class _Agg:
 
     def snapshot(self) -> dict:
         mean = self.total / self.n if self.n else 0.0
-        return {
+        out = {
             "n": self.n,
             "total": round(self.total, 9),
             "mean": round(mean, 9),
@@ -137,6 +145,11 @@ class _Agg:
             # JSON keys must be strings; "k" means bucket (2^(k-1), 2^k]
             "log2_buckets": {str(k): v for k, v in sorted(self.buckets.items())},
         }
+        if self.exemplars:
+            out["exemplars"] = {
+                str(k): {"trace_id": t, "value": round(v, 9)}
+                for k, (t, v) in sorted(self.exemplars.items())}
+        return out
 
 
 class _State:
@@ -219,7 +232,9 @@ def _init():
                     or os.environ.get("HPNN_SPANS")
                     or os.environ.get("HPNN_COST")
                     or os.environ.get("HPNN_COLLECTOR")
-                    or os.environ.get("HPNN_ALERTS")):
+                    or os.environ.get("HPNN_ALERTS")
+                    or os.environ.get("HPNN_SAMPLE")
+                    or os.environ.get("HPNN_CAPSULE_DIR")):
                 _state = False
                 return False
             path = None
@@ -237,6 +252,10 @@ def _init():
         from hpnn_tpu.obs import alerts
 
         alerts._install()
+    if os.environ.get("HPNN_CAPSULE_DIR"):
+        from hpnn_tpu.obs import triggers
+
+        triggers._install()
     _emit(st, {"ev": "obs.open", "kind": "event", "pid": os.getpid(),
                "rank": _process_index()})
     return st
@@ -354,6 +373,24 @@ def gauge(name: str, value, **fields) -> None:
     hook = _gauge_hook
     if hook is not None:
         hook(name, v)  # alert rule evaluation (obs/alerts.py)
+
+
+def exemplar(name: str, value, trace: str) -> None:
+    """Attach a trace-id exemplar to the named aggregate's bucket for
+    ``value`` — last-write-wins per bucket, rendered on ``/metrics``
+    as ``# {trace_id="..."}`` suffixes (obs/export.py).  Called by the
+    tail sampler (obs/forensics.py) when an emitted request span
+    carries a trace; a no-op when the registry is inactive or the
+    trace is empty."""
+    st = _active()
+    if st is None or not trace:
+        return
+    v = float(value)
+    with st.lock:
+        agg = st.aggs.get(name)
+        if agg is None:
+            agg = st.aggs[name] = _Agg()
+        agg.mark(v, str(trace))
 
 
 def observe(name: str, values, **fields) -> None:
@@ -556,6 +593,7 @@ def _reset_for_tests() -> None:
                  "hpnn_tpu.obs.spans", "hpnn_tpu.obs.slo",
                  "hpnn_tpu.obs.propagate", "hpnn_tpu.obs.collector",
                  "hpnn_tpu.obs.alerts", "hpnn_tpu.obs.lockwatch",
+                 "hpnn_tpu.obs.forensics", "hpnn_tpu.obs.triggers",
                  "hpnn_tpu.chaos", "hpnn_tpu.online.wal"):
         mod = sys.modules.get(name)
         if mod is not None:

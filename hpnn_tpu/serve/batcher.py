@@ -214,10 +214,12 @@ class Batcher:
                 raise QueueFull(
                     f"batcher {self.name!r} queue at max_depth="
                     f"{self.max_depth}; retry later")
-            if obs.spans.enabled():
+            if obs.spans.enabled() or isinstance(span, obs.spans.Span):
                 # inside the lock, before the append: the drain thread
                 # cannot pop the request until we release, and
-                # spans.start neither locks nor emits
+                # spans.start neither locks nor emits.  A real parent
+                # without the global knob is a tail-sampled request
+                # (obs/forensics.py) — its tree still grows
                 qfields = {"batcher": self.name}
                 if req_id is not None:
                     qfields["req_id"] = req_id

@@ -18,6 +18,9 @@ same Session, for drivers that aren't Python:
   (client-sent ``req_id`` honored, else edge-minted) that threads
   through the request's spans (docs/serving.md).
 * ``POST /v1/reload`` ``{"kernel": n}`` → re-read the kernel file.
+* ``POST /v1/capture`` ``{"reason": s?}`` → snap a forensic capture
+  capsule on demand (obs/triggers.py; ``HPNN_CAPSULE_DIR``); 404
+  unarmed, 429 while one is in flight or cooling down.
 * ``POST /ingest`` (alias ``/v1/ingest``)
   ``{"kernel": n?, "inputs": [...], "targets": [...]}`` → feed the
   online-learning sample buffer when an ``OnlineSession`` is attached
@@ -246,6 +249,8 @@ class Session:
         doc["obs"] = obs.export.health()
         doc["slo"] = obs.slo.health_doc()
         doc["alerts"] = obs.alerts.health_doc()
+        doc["sampler"] = obs.forensics.health_doc()
+        doc["capsules"] = obs.triggers.health_doc()
         if self.online_health is not None:
             doc["online"] = self.online_health()
         return doc
@@ -311,7 +316,9 @@ class Session:
         if req_id is not None:
             sfields["req_id"] = req_id
         sfields.update(obs.propagate.fields(trace))
-        span = obs.spans.start("serve.request", **sfields)
+        # a real span under HPNN_SPANS, a sampled/promotable one under
+        # HPNN_SAMPLE, the shared null span otherwise (obs/forensics.py)
+        span = obs.forensics.request_span("serve.request", **sfields)
         slo_on = obs.slo.enabled()
         t0 = self._clock() if slo_on else 0.0
         try:
@@ -321,21 +328,21 @@ class Session:
                                     timeout_s=timeout_s, span=span,
                                     req_id=req_id)
         except QueueFull as exc:  # Shed is a QueueFull subclass
-            obs.spans.finish(span, failed=type(exc).__name__)
+            obs.forensics.finish(span, failed=type(exc).__name__)
             if slo_on:
                 obs.slo.record("shed")
             raise
         except DeadlineExceeded as exc:
-            obs.spans.finish(span, failed=type(exc).__name__)
+            obs.forensics.finish(span, failed=type(exc).__name__)
             if slo_on:
                 obs.slo.record("expired")
             raise
         except BaseException as exc:
-            obs.spans.finish(span, failed=type(exc).__name__)
+            obs.forensics.finish(span, failed=type(exc).__name__)
             if slo_on:
                 obs.slo.record("error")
             raise
-        obs.spans.finish(span)
+        obs.forensics.finish(span)
         if slo_on:
             obs.slo.record("ok", latency_s=self._clock() - t0)
         return out[0] if single else out
@@ -480,6 +487,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._reload(req)
         elif self.path in ("/ingest", "/v1/ingest"):
             self._ingest(req)
+        elif self.path == "/v1/capture":
+            # manual forensic capsule (obs/triggers.py): 404 when
+            # HPNN_CAPSULE_DIR is unarmed, 429 when suppressed
+            code, payload = obs.triggers.http_capture(req)
+            self._reply(code, payload)
         else:
             self._reply(404, {"error": f"no such path {self.path}"})
 

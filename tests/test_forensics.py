@@ -1,0 +1,315 @@
+"""Tail-latency forensics: head sampling + retro-promotion
+(obs/forensics.py), capture capsules (obs/triggers.py), histogram
+exemplars, the slowest-N blame report (tools/tail_report.py), and the
+``--forensics`` schema lint.
+
+The plane's contract is the usual obs one — unset ⇒ constant-time
+no-ops, never stdout — plus its own: the coin flip may miss a slow
+request but the promotion path must still emit its root; a capture
+runs at most one at a time and never reuses a capsule path."""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import forensics, registry, spans, triggers
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _kernel():
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    return k
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _arm(monkeypatch, tmp_path, **env):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    for key, val in env.items():
+        monkeypatch.setenv(key, str(val))
+    obs._reset_for_tests()
+    return tmp_path / "m.jsonl"
+
+
+# ------------------------------------------------------------- sampler
+def test_sampler_disabled_everything_noops(monkeypatch):
+    monkeypatch.delenv("HPNN_SAMPLE", raising=False)
+    obs._reset_for_tests()
+    assert not forensics.enabled()
+    sp = forensics.request_span("serve.request")
+    assert sp is spans._NULL_SPAN
+    forensics.finish(sp)                    # no raise
+    forensics.finish(None)
+    assert forensics.health_doc() == {"armed": False}
+
+
+def test_sampler_bad_rate_disarms_with_warning(monkeypatch, capsys):
+    monkeypatch.setenv("HPNN_SAMPLE", "2.0")
+    obs._reset_for_tests()
+    assert not forensics.enabled()
+    assert "HPNN_SAMPLE" in capsys.readouterr().err
+    # memoized: the second call never re-reads the env
+    monkeypatch.setenv("HPNN_SAMPLE", "0.5")
+    assert not forensics.enabled()
+
+
+def test_sampled_request_emits_root_and_exemplar(tmp_path, monkeypatch):
+    """rate=1 ⇒ every request gets a real span tree (sampled tag) and
+    marks a histogram exemplar; the root lands in the capsule ring."""
+    sink = _arm(monkeypatch, tmp_path, HPNN_SAMPLE="1")
+    sp = forensics.request_span("serve.request", trace="tr1")
+    assert isinstance(sp, spans.Span)
+    forensics.finish(sp)
+    (rec,) = [r for r in _read(sink) if r["ev"] == "span.end"]
+    assert rec["name"] == "serve.request"
+    assert rec["sampled"] is True
+    assert forensics.recent_spans()[-1]["span"] == rec["span"]
+    snap = obs.snapshot_state()
+    ex = snap["aggregates"]["serve.request"]["exemplars"]
+    assert any(v["trace_id"] == "tr1" for v in ex.values())
+    assert forensics.health_doc()["recent_spans"] >= 1
+
+
+def test_unsampled_probe_promotes_when_slow(tmp_path, monkeypatch):
+    """A probe (coin flip lost) slower than the HPNN_SAMPLE_SLOW_MS
+    floor is retro-promoted: a backdated root with ``promoted`` set
+    plus a forensics.tail_promote count."""
+    sink = _arm(monkeypatch, tmp_path, HPNN_SAMPLE="0.000001",
+                HPNN_SAMPLE_SLOW_MS="1")
+    fast = forensics.request_span("serve.request")
+    assert isinstance(fast, forensics._Probe)
+    forensics.finish(fast)                  # under the floor: silent
+    slow = forensics.request_span("serve.request", trace="tr2")
+    time.sleep(0.01)
+    forensics.finish(slow)
+    recs = _read(sink)
+    (root,) = [r for r in recs if r["ev"] == "span.end"]
+    assert root["promoted"] is True
+    assert root["dt"] >= 0.01
+    (promote,) = [r for r in recs
+                  if r["ev"] == "forensics.tail_promote"]
+    assert promote["root"] == "serve.request"
+    assert forensics.recent_spans()[-1]["promoted"] is True
+
+
+def test_double_finish_is_idempotent(tmp_path, monkeypatch):
+    sink = _arm(monkeypatch, tmp_path, HPNN_SAMPLE="1")
+    sp = forensics.request_span("serve.request")
+    forensics.finish(sp)
+    forensics.finish(sp)
+    assert len([r for r in _read(sink)
+                if r["ev"] == "span.end"]) == 1
+
+
+def test_exemplar_noop_when_inactive_or_traceless(monkeypatch):
+    monkeypatch.delenv("HPNN_METRICS", raising=False)
+    obs._reset_for_tests()
+    registry.exemplar("unit.lat", 1.0, "tr")     # inactive: no raise
+    monkeypatch.setenv("HPNN_SAMPLE", "1")
+    obs._reset_for_tests()
+    obs.observe("unit.lat", 1.0)
+    registry.exemplar("unit.lat", 1.0, "")       # empty trace ignored
+    agg = obs.snapshot_state()["aggregates"]["unit.lat"]
+    assert not agg.get("exemplars")
+
+
+def test_metrics_render_carries_exemplar_suffix(tmp_path, monkeypatch):
+    from hpnn_tpu.obs import export
+
+    _arm(monkeypatch, tmp_path, HPNN_SAMPLE="1")
+    obs.observe("serve.request", [0.01, 0.02, 0.04])
+    registry.exemplar("serve.request", 0.04, "abc123")
+    text = export.render_prometheus(obs.snapshot_state())
+    tagged = [ln for ln in text.splitlines()
+              if ' # {trace_id="abc123"} ' in ln]
+    assert tagged and 'quantile=' in tagged[0]
+
+
+# ------------------------------------------------------------ capsules
+def _arm_capsules(monkeypatch, tmp_path, **extra):
+    capdir = tmp_path / "capsules"
+    env = {"HPNN_SAMPLE": "1", "HPNN_CAPSULE_DIR": str(capdir),
+           "HPNN_CAPSULE_PROFILE_MS": "0",
+           "HPNN_CAPSULE_COOLDOWN_S": "0"}
+    env.update(extra)
+    sink = _arm(monkeypatch, tmp_path, **env)
+    return sink, capdir
+
+
+def test_capture_capsule_contents_and_census(tmp_path, monkeypatch):
+    sink, capdir = _arm_capsules(monkeypatch, tmp_path)
+    sp = forensics.request_span("serve.request", trace="tr3")
+    forensics.finish(sp)
+    man = triggers.capture("unit")
+    assert man is not None
+    assert set(man["files"]) >= {"spans.jsonl", "gauges.json",
+                                 "health.json"}
+    assert man["spans"] == 1
+    assert man["profile"] is None           # PROFILE_MS=0 skips it
+    ring = _read(os.path.join(man["capsule"], "spans.jsonl"))
+    assert ring[0]["name"] == "serve.request"
+    census = triggers.health_doc()
+    assert census["captures"] == 1 and not census["in_flight"]
+    recs = _read(sink)
+    (begin,) = [r for r in recs if r["ev"] == "forensics.capture"]
+    (done,) = [r for r in recs if r["ev"] == "forensics.capture_done"]
+    assert begin["capsule"] == done["capsule"] == man["capsule"]
+    assert done["spans"] == 1
+
+
+def test_capture_cooldown_skips_and_counts(tmp_path, monkeypatch):
+    sink, _capdir = _arm_capsules(monkeypatch, tmp_path,
+                                  HPNN_CAPSULE_COOLDOWN_S="3600")
+    first = triggers.capture("unit")
+    assert first is not None
+    assert triggers.capture("unit") is None      # cooling down
+    census = triggers.health_doc()
+    assert census["skipped"].get("cooldown") == 1
+    (skip,) = [r for r in _read(sink)
+               if r["ev"] == "forensics.capture_skipped"]
+    assert skip["reason"] == "cooldown"
+
+
+def test_capsule_paths_never_reused(tmp_path, monkeypatch):
+    _sink, _capdir = _arm_capsules(monkeypatch, tmp_path)
+    paths = {triggers.capture("unit")["capsule"] for _ in range(3)}
+    assert len(paths) == 3
+
+
+def test_http_capture_status_codes(tmp_path, monkeypatch):
+    monkeypatch.delenv("HPNN_CAPSULE_DIR", raising=False)
+    obs._reset_for_tests()
+    status, body = triggers.http_capture(None)
+    assert status == 404 and "error" in body
+    _sink, _capdir = _arm_capsules(monkeypatch, tmp_path,
+                                   HPNN_CAPSULE_COOLDOWN_S="3600")
+    status, body = triggers.http_capture({"reason": "why so slow"})
+    assert status == 200
+    assert body["manifest"]["reason"].startswith("manual:")
+    status, body = triggers.http_capture(None)   # cooling down
+    assert status == 429 and body["skipped"].get("cooldown") == 1
+
+
+def test_alert_fire_triggers_capture(tmp_path, monkeypatch):
+    """The wired loop without HTTP: an armed threshold rule breached
+    by a gauge call admits an async capsule."""
+    _sink, capdir = _arm_capsules(
+        monkeypatch, tmp_path,
+        HPNN_ALERTS="hot@unit.temp>10:for=0,cooldown=0,severity=warn")
+    obs.gauge("unit.temp", 99.0)
+    deadline = time.monotonic() + 5.0
+    man_path = None
+    while time.monotonic() < deadline and man_path is None:
+        for dirpath, _dirs, files in os.walk(capdir):
+            if "manifest.json" in files:
+                man_path = os.path.join(dirpath, "manifest.json")
+        time.sleep(0.02)
+    assert man_path is not None
+    with open(man_path) as fp:
+        man = json.load(fp)
+    assert man["reason"] == "alert:hot"
+    assert man["alert"]["gauge"] == "unit.temp"
+
+
+# --------------------------------------------------------- tail report
+def test_tail_report_blames_the_slow_phase(tmp_path, monkeypatch):
+    """Sampled serve traffic through a real Session: every request is
+    a root, and the analyzer's per-phase split covers the root time
+    (no phase, including gap, goes negative)."""
+    sink = _arm(monkeypatch, tmp_path, HPNN_SAMPLE="1")
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=0.5)
+    sess.register_kernel("k", _kernel())
+    for _ in range(4):
+        sess.infer("k", np.zeros(8))
+    sess.close()
+    obs.configure(None)
+    tr = _load_tool("tail_report")
+    rep = tr.analyze(tr.load_spans([str(sink)]), top=10)
+    assert rep["requests"] == 4
+    assert all(v >= 0.0 for v in rep["blame_pct"].values())
+    assert abs(sum(rep["blame_pct"].values()) - 100.0) < 1.0
+    slowest = rep["slowest"][0]
+    assert slowest["sampled"] is True
+    assert slowest["phases"]["dispatch"] >= 0.0
+
+
+# --------------------------------------------------------------- lint
+def _forensics_sink(tmp_path, monkeypatch):
+    """A real armed run: one sampled root, one promotion, one capture
+    — the accept fixture for lint_forensics."""
+    sink, _capdir = _arm_capsules(monkeypatch, tmp_path,
+                                  HPNN_SAMPLE_SLOW_MS="1")
+    sp = forensics.request_span("serve.request", trace="tr4")
+    forensics.finish(sp)
+    assert triggers.capture("unit") is not None
+    obs.configure(None)
+    return sink
+
+
+def test_lint_forensics_accepts_a_real_run(tmp_path, monkeypatch):
+    sink = _forensics_sink(tmp_path, monkeypatch)
+    lint = _load_tool("check_obs_catalog")
+    assert lint.lint_forensics(str(sink)) == []
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda r: r.update(ev="forensics.capture_skipped", reason="nap"),
+     "reason"),
+    (lambda r: r.update(ev="forensics.tail_promote", n=0, dt=0.1,
+                        root="serve.request"),
+     "n"),
+    (lambda r: r.update(ev="forensics.capture_done", reason="x",
+                        capsule="/nowhere", duration_s=0.1, files=1,
+                        spans=0, profile=False),
+     "paired"),
+])
+def test_lint_forensics_break_ladder(tmp_path, monkeypatch, mutate,
+                                     needle):
+    sink = _forensics_sink(tmp_path, monkeypatch)
+    bad = {"kind": "count", "n": 1}
+    mutate(bad)
+    with open(sink, "a") as fp:
+        fp.write(json.dumps(bad) + "\n")
+    lint = _load_tool("check_obs_catalog")
+    failures = lint.lint_forensics(str(sink))
+    assert failures and any(needle in f for f in failures)
+
+
+def test_lint_forensics_rejects_nonfinite_exemplar(tmp_path,
+                                                   monkeypatch):
+    sink = _forensics_sink(tmp_path, monkeypatch)
+    rec = {"ev": "obs.summary", "kind": "summary", "uptime_s": 1.0,
+           "counters": {}, "gauges": {},
+           "aggregates": {"serve.request": {"n": 1, "exemplars": {
+               "7": {"trace_id": "t", "value": "NaN"}}}}}
+    with open(sink, "a") as fp:
+        fp.write(json.dumps(rec) + "\n")
+    lint = _load_tool("check_obs_catalog")
+    assert any("finite" in f
+               for f in lint.lint_forensics(str(sink)))
+
+
+def test_lint_forensics_wants_an_armed_run(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text('{"ev": "obs.open", "kind": "meta"}\n')
+    lint = _load_tool("check_obs_catalog")
+    assert any("HPNN_SAMPLE" in f
+               for f in lint.lint_forensics(str(empty)))

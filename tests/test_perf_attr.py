@@ -347,7 +347,8 @@ def _parse_label_value(s):
     out, i = [], 0
     while i < len(s):
         if s[i] == "\\":
-            out.append({"n": "\n", '"': '"', "\\": "\\"}[s[i + 1]])
+            out.append({"n": "\n", "r": "\r", '"': '"',
+                        "\\": "\\"}[s[i + 1]])
             i += 2
         else:
             out.append(s[i])
@@ -357,29 +358,37 @@ def _parse_label_value(s):
 
 def test_export_label_escaping_round_trip(tmp_path, monkeypatch):
     assert export._metric_name("perf.mfu") == "hpnn_perf_mfu"
-    val = 'a"b\\c\nd'
+    val = 'a"b\\c\nd\re'
     esc = export._escape_label_value(val)
     assert "\n" not in esc                  # exposition is line-based
+    assert "\r" not in esc                  # splitlines() splits on \r
     assert _parse_label_value(esc) == val
     rendered = export._render_labels({"exe": val, "quantile": 0.5})
     assert rendered.startswith("{") and rendered.endswith("}")
     assert export._render_labels({}) == ""
 
-    # full exposition round trip: render a live snapshot and parse
-    # every sample line back per the 0.0.4 grammar
+    # full exposition round trip: render a live snapshot — with a
+    # tail-sampler exemplar marked, so quantile lines carry the
+    # `` # {trace_id="..."} v`` suffix — and parse every sample line
+    # back per the 0.0.4 grammar
     import re
+
+    from hpnn_tpu.obs import registry
 
     monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
     obs._reset_for_tests()
     obs.gauge("perf.mfu", 0.25)
     obs.observe("unit.lat", [1.0, 2.0])
+    trace = 'tr"ace\r1'                     # worst-case id round-trips
+    registry.exemplar("unit.lat", 2.0, trace)
     text = export.render_prometheus(obs.snapshot_state())
     assert "hpnn_perf_mfu 0.25" in text
     sample = re.compile(
         r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
         r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
-        r' (-?[0-9.eE+-]+|NaN)$')
-    parsed = 0
+        r' (-?[0-9.eE+-]+|NaN)'
+        r'(?: # \{trace_id="((?:[^"\\]|\\.)*)"\} (-?[0-9.eE+-]+|NaN))?$')
+    parsed = exemplars = 0
     for line in text.strip().splitlines():
         if line.startswith("#"):
             assert line.startswith("# TYPE "), line
@@ -390,5 +399,10 @@ def test_export_label_escaping_round_trip(tmp_path, monkeypatch):
         for lab in re.finditer(r'="((?:[^"\\]|\\.)*)"',
                                m.group(2) or ""):
             _parse_label_value(lab.group(1))
+        if m.group(4) is not None:
+            assert _parse_label_value(m.group(4)) == trace
+            assert float(m.group(5)) == 2.0
+            exemplars += 1
         parsed += 1
     assert parsed >= 5
+    assert exemplars >= 1

@@ -120,6 +120,18 @@ GATE_METRICS = {
     "collector_overhead_pct": ("lower", 2.00),
     "drill_alert_fire_s": ("lower", 1.50),
     "drill_alert_resolved": ("higher", 0.01),
+    # tail-latency forensics fold-in (bench.py bench_sampler_overhead
+    # + tools/chaos_drill.py run_bench_capsule_drill;
+    # docs/observability.md "Forensics"): the paired marginal cost of
+    # the tail sampler at rate 1 on the serve hot path (acceptance
+    # bar <=5% — medians hover near zero, so the tolerance is wide
+    # like the other overhead gates), time from the alert trigger to
+    # a landed capsule manifest, and the share of tail time
+    # tail_report pins on the delayed dispatch seam (the drill
+    # injects there, so the blame must not drift away from it)
+    "sampler_overhead_pct": ("lower", 2.00),
+    "drill_capsule_capture_s": ("lower", 1.50),
+    "drill_capsule_blame_pct": ("higher", 0.30),
     # cross-host fleet fold-ins (tools/chaos_drill.py
     # run_bench_worker_drill + tools/bench_autoscale.py;
     # docs/serving.md "Cross-host fleet"): the worker-process kill

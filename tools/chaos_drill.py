@@ -50,16 +50,28 @@ catalog (docs/resilience.md):
   survivor answers) and that the supervisor restart policy REPLACES
   it — readiness-gated — within a bounded ``replaced_s``.
 
+* **capsule** — the tail-latency forensics plane's live proof
+  (docs/observability.md "Forensics"): tail sampler armed
+  (``HPNN_SAMPLE``), a chaos delay injected at the
+  ``serve.dispatch`` seam, an ``slo.p99_ms`` threshold rule and a
+  capsule dir (``HPNN_CAPSULE_DIR``).  Load drives p99 over the
+  bound; asserts the fired alert landed a capture capsule (spans +
+  a real profiler window) within a bounded window, and that
+  ``tools/tail_report.py`` over the run's sink blames the dispatch
+  phase — the injected seam — for most of the tail.
+
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
 ``drill.reload`` | ``drill.sentinel`` | ``drill.replica`` |
-``drill.alert`` | ``drill.worker``; :func:`run_bench_drill` /
+``drill.alert`` | ``drill.worker`` | ``drill.capsule``;
+:func:`run_bench_drill` /
 :func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` /
-:func:`run_bench_worker_drill` are
+:func:`run_bench_worker_drill` / :func:`run_bench_capsule_drill` are
 the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
 ``drill_replica_dip_pct`` / ``drill_replica_survivors_lost`` /
 ``drill_alert_fire_s`` / ``drill_alert_resolved`` /
-``drill_worker_dip_pct`` / ``drill_worker_replaced_s``, gated by
+``drill_worker_dip_pct`` / ``drill_worker_replaced_s`` /
+``drill_capsule_capture_s`` / ``drill_capsule_blame_pct``, gated by
 ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
@@ -782,6 +794,136 @@ def drill_worker(workdir: str, *, rate: float = 60.0,
         sup.close()
 
 
+def drill_capsule(workdir: str, *, rate: float = 12.0,
+                  seed: int = 6, delay_ms: float = 40.0) -> dict:
+    """The tail-latency forensics plane's live proof
+    (docs/observability.md "Forensics"): an in-process serve Session
+    with the tail sampler armed (``HPNN_SAMPLE=0.5``), a chaos delay
+    injected at the ``serve.dispatch`` seam, an ``slo.p99_ms``
+    threshold rule, and a capsule dir.  Loadgen traffic drives p99
+    over the bound → the alert fires → the capture trigger lands a
+    capsule (spans + gauges + a real ``jax.profiler`` window).
+    Asserts the capsule manifest landed within a bounded window of
+    the fire with a non-empty span ring and profile, and that
+    ``tools/tail_report.py`` over the run's sink blames the
+    **dispatch** phase for most of the tail — the injected seam, not
+    a neighbor (``drill_capsule_capture_s`` /
+    ``drill_capsule_blame_pct`` in bench_gate.py)."""
+    from hpnn_tpu import chaos as chaos_mod
+    from hpnn_tpu import obs
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve import Session, make_server
+
+    import tail_report
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.capsule", "ok": False,
+                 "delay_ms": delay_ms}
+    # Keep the offered inter-arrival above the injected delay so
+    # batches stay singletons: a backlogged queue would launder the
+    # dispatch delay into queue time (and, for non-batch[0] members,
+    # into cross-request gap) and the blame assertion below would
+    # point at the wrong phase.
+    rate = min(rate, 500.0 / max(delay_ms, 1.0))
+    sink = os.path.join(workdir, "capsule-drill.metrics.jsonl")
+    capsule_dir = os.path.join(workdir, "capsules")
+    env_keys = ("HPNN_SAMPLE", "HPNN_CAPSULE_DIR",
+                "HPNN_CAPSULE_PROFILE_MS", "HPNN_CAPSULE_COOLDOWN_S",
+                "HPNN_ALERTS", "HPNN_SLO_MS", "HPNN_CHAOS",
+                "HPNN_CHAOS_SEED", "HPNN_METRICS")
+    prev_env = {key: os.environ.get(key) for key in env_keys}
+    os.environ["HPNN_SAMPLE"] = "0.5"
+    os.environ["HPNN_CAPSULE_DIR"] = capsule_dir
+    os.environ["HPNN_CAPSULE_PROFILE_MS"] = "50"
+    os.environ["HPNN_CAPSULE_COOLDOWN_S"] = "0"
+    os.environ["HPNN_SLO_MS"] = str(delay_ms / 2.0)
+    # for=1.0 holds the fire until the breach has been true for a
+    # second of traffic, so the span ring has sampled roots by the
+    # time the capsule's spans.jsonl is written (a for=0 rule can
+    # fire off the very first completed request, before any sampled
+    # root has closed, and capture an empty ring).
+    os.environ["HPNN_ALERTS"] = (
+        f"tail_p99@slo.p99_ms>{delay_ms / 2.0}:"
+        "for=1.0,cooldown=0,severity=warn")
+    os.environ["HPNN_CHAOS"] = f"delay@serve.dispatch:ms={delay_ms}"
+    os.environ["HPNN_CHAOS_SEED"] = "1"
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    session = server = None
+
+    def _manifest():
+        for dirpath, _dirs, files in os.walk(capsule_dir):
+            if "manifest.json" in files:
+                return os.path.join(dirpath, "manifest.json")
+        return None
+
+    try:
+        # warm compile BEFORE arming obs: the first-request JIT stall
+        # (hundreds of ms) would otherwise spike slo.p99_ms and fire
+        # the alert off the warmup, not the injected seam
+        session = Session(max_batch=16, n_buckets=2, max_wait_ms=0.5)
+        session.register_kernel(KERNEL, k)
+        warm = np.linspace(-1.0, 1.0, 8)
+        for _ in range(3):
+            session.infer(KERNEL, warm, timeout_s=10.0)
+        obs.configure(sink)   # re-reads every knob: sampler, rule,
+        chaos_mod._reset_for_tests()  # capsule hook, chaos plan
+        server = make_server(session)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        load = _Load(port, rate=rate, ingest_frac=0.0, seed=seed)
+        fired = _wait(lambda: (obs.alerts.health_doc().get("active")
+                               or None), 15.0, interval_s=0.02)
+        t_fire = load.now()
+        manifest_path = _wait(_manifest, 10.0, interval_s=0.05)
+        t_capsule = load.now()
+        records = load.finish(settle_s=0.2)
+        census = obs.triggers.health_doc()
+        obs.configure(None)   # close the sink for the audit below
+        out["requests"] = len(records)
+        out["fired"] = bool(fired)
+        out["capture_s"] = (round(t_capsule - t_fire, 3)
+                            if fired and manifest_path else None)
+        man = {}
+        if manifest_path:
+            with open(manifest_path) as fp:
+                man = json.load(fp)
+        out["capsule"] = man.get("capsule")
+        out["capsule_spans"] = man.get("spans", 0)
+        profile = man.get("profile") or {}
+        out["profile_files"] = profile.get("files", 0)
+        out["captures_total"] = census.get("captures", 0)
+        # the forensic half: the report over the run's own sink must
+        # pin the tail on the injected seam
+        rep = tail_report.analyze(tail_report.load_spans([sink]),
+                                  top=5)
+        out["sampled_roots"] = rep["requests"]
+        out["blame_pct"] = rep["blame_pct"]
+        out["dispatch_blame_pct"] = rep["blame_pct"].get("dispatch",
+                                                         0.0)
+        out["ok"] = bool(fired and manifest_path
+                         and str(man.get("reason", "")
+                                 ).startswith("alert:tail_p99")
+                         and out["capsule_spans"] > 0
+                         and out["profile_files"] > 0
+                         and rep["requests"] > 0
+                         and out["dispatch_blame_pct"] > 50.0)
+        return out
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if session is not None:
+            session.close()
+        obs.configure(None)
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        chaos_mod._reset_for_tests()
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
@@ -789,6 +931,7 @@ DRILLS = {
     "replica": drill_replica,
     "alert": drill_alert,
     "worker": drill_worker,
+    "capsule": drill_capsule,
 }
 
 
@@ -898,6 +1041,28 @@ def run_bench_worker_drill(*, rate: float = 60.0,
     return out
 
 
+def run_bench_capsule_drill(*, rate: float = 60.0) -> dict:
+    """The bench.py fold-in for the capsule drill: sampler + delayed
+    dispatch seam + firing p99 rule under load, reported as gateable
+    numbers (``drill_capsule_capture_s`` /
+    ``drill_capsule_blame_pct``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_capsule(tmp, rate=rate)
+    out = {
+        "metric": "capsule_drill",
+        "drill": row,
+        "capture_s": row.get("capture_s"),
+        "dispatch_blame_pct": row.get("dispatch_blame_pct"),
+        "capsule_spans": row.get("capsule_spans"),
+        "profile_files": row.get("profile_files"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 # --------------------------------------------------------------- main
 
 
@@ -905,10 +1070,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
                     "(kill9 / reload / sentinel / replica / alert / "
-                    "worker)")
+                    "worker / capsule)")
     ap.add_argument("--drill", default="all",
                     choices=("all", "kill9", "reload", "sentinel",
-                             "replica", "alert", "worker"))
+                             "replica", "alert", "worker", "capsule"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

@@ -96,11 +96,24 @@ without its latency, or a scale event with an infinite or shrinking
 "grow" width makes a capacity incident unauditable, so their shapes
 (and the per-rank up/down pairing) are frozen too.
 
+And the tail-latency forensics schema lint (:func:`lint_forensics`):
+the ``forensics.capture`` / ``forensics.capture_done`` capsule edges
+(obs/triggers.py, HPNN_CAPSULE_DIR), the ``forensics.capture_skipped``
+suppression census, the ``forensics.tail_promote`` retro-promotion
+counts (obs/forensics.py, HPNN_SAMPLE) and the exemplar blocks inside
+``obs.summary`` aggregates are how an operator goes from a bad
+histogram bucket to the one request that produced it — a capture that
+never finishes, a skip without a reason, or an exemplar with a NaN
+value severs that link, so their shapes (and the per-process
+capture/capture_done pairing) are frozen too (docs/observability.md
+"Forensics").
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
         [--serve-replicas PATH] [--fleet PATH] [--cluster PATH]
+        [--forensics PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -843,7 +856,7 @@ def lint_quant(path: str) -> list[str]:
 CHAOS_ACTIONS = ("kill", "raise", "delay", "nan")
 WAL_SKIP_REASONS = ("sig", "torn", "magic")
 DRILL_EVS = ("drill.kill9", "drill.reload", "drill.sentinel",
-             "drill.replica", "drill.worker")
+             "drill.replica", "drill.worker", "drill.capsule")
 
 
 def lint_chaos(path: str) -> list[str]:
@@ -1509,6 +1522,195 @@ def lint_cluster(path: str) -> list[str]:
     return failures
 
 
+# the tail-latency forensics record contracts (obs/forensics.py,
+# obs/triggers.py; docs/observability.md "Forensics")
+SKIP_REASONS = ("in_flight", "cooldown", "io_error")
+
+
+def lint_forensics(path: str) -> list[str]:
+    """Schema-lint the tail-latency forensics records of one metrics
+    sink (a run with ``HPNN_SAMPLE`` and/or ``HPNN_CAPSULE_DIR``
+    armed — docs/observability.md "Forensics").
+
+    Checks, per record:
+
+    * ``forensics.capture`` events — non-empty ``reason`` and
+      ``capsule`` path; per process, at most one capture in flight (a
+      second begin before the previous ``capture_done`` means the
+      admission gate is broken) and no capsule path reused.
+    * ``forensics.capture_done`` events — same ``reason``/``capsule``
+      shape; the capsule must pair with a prior unfinished capture;
+      finite non-negative ``duration_s``; non-negative int ``files``
+      and ``spans`` tallies; a bool ``profile`` flag.  Captures still
+      in flight at EOF are fine (the process may have been snapping
+      when the sink closed).
+    * ``forensics.capture_skipped`` counts — ``kind == "count"``,
+      positive ``n``, ``reason`` one of in_flight/cooldown/io_error
+      (a suppressed trigger that can't say why is undebuggable).
+    * ``forensics.tail_promote`` counts — ``kind == "count"``,
+      positive ``n``, finite non-negative ``dt`` (the latency that
+      crossed the threshold), non-empty ``root`` span name.
+    * ``obs.summary`` aggregates — every ``exemplars`` block maps
+      int-parseable bucket keys to ``{trace_id, value}`` objects with
+      a non-empty string trace id and a finite number value (a NaN
+      exemplar severs the histogram→trace link /metrics exists to
+      provide).
+
+    A sink with no ``forensics.*`` records and no exemplar blocks
+    fails — this lint only makes sense on a forensics-armed run.
+    Returns failure strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_forensics = 0
+    in_flight: dict = {}     # pid -> open capsule path
+    seen_capsules: set = set()
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if isinstance(ev, str) and ev.startswith("forensics."):
+            n_forensics += 1
+        if ev in ("forensics.capture", "forensics.capture_done"):
+            r = rec.get("reason")
+            if not isinstance(r, str) or not r:
+                failures.append(
+                    f"{at}: {ev} reason {r!r} is not a non-empty "
+                    "string")
+            cap = rec.get("capsule")
+            if not isinstance(cap, str) or not cap:
+                failures.append(
+                    f"{at}: {ev} capsule {cap!r} is not a non-empty "
+                    "string")
+                continue
+            # collector-merged streams tag the sender pid; a raw
+            # single-process sink has none — one shared slot then
+            pid = rec.get("pid")
+            if ev == "forensics.capture":
+                if in_flight.get(pid) is not None:
+                    failures.append(
+                        f"{at}: forensics.capture for {cap!r} while "
+                        f"{in_flight[pid]!r} is still in flight (the "
+                        "at-most-one admission gate is broken)")
+                if cap in seen_capsules:
+                    failures.append(
+                        f"{at}: capsule path {cap!r} reused")
+                seen_capsules.add(cap)
+                in_flight[pid] = cap
+            else:
+                if in_flight.get(pid) != cap:
+                    failures.append(
+                        f"{at}: forensics.capture_done for {cap!r} "
+                        "with no paired unfinished forensics.capture")
+                else:
+                    in_flight[pid] = None
+                d = rec.get("duration_s")
+                if not _num(d) or not math.isfinite(d) or d < 0:
+                    failures.append(
+                        f"{at}: capture_done duration_s {d!r} is not "
+                        "a finite non-negative number")
+                for key in ("files", "spans"):
+                    v = rec.get(key)
+                    if not isinstance(v, int) or isinstance(v, bool) \
+                            or v < 0:
+                        failures.append(
+                            f"{at}: capture_done {key} {v!r} is not a "
+                            "non-negative int")
+                if not isinstance(rec.get("profile"), bool):
+                    failures.append(
+                        f"{at}: capture_done profile "
+                        f"{rec.get('profile')!r} is not a bool")
+        elif ev == "forensics.capture_skipped":
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: capture_skipped kind {rec.get('kind')!r} "
+                    "!= 'count'")
+            if not _pos_int(rec.get("n")):
+                failures.append(
+                    f"{at}: capture_skipped increment "
+                    f"{rec.get('n')!r} is not a positive int")
+            if rec.get("reason") not in SKIP_REASONS:
+                failures.append(
+                    f"{at}: capture_skipped reason "
+                    f"{rec.get('reason')!r} not in "
+                    f"{'/'.join(SKIP_REASONS)}")
+        elif ev == "forensics.tail_promote":
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: tail_promote kind {rec.get('kind')!r} "
+                    "!= 'count'")
+            if not _pos_int(rec.get("n")):
+                failures.append(
+                    f"{at}: tail_promote increment {rec.get('n')!r} "
+                    "is not a positive int")
+            dt = rec.get("dt")
+            if not _num(dt) or not math.isfinite(dt) or dt < 0:
+                failures.append(
+                    f"{at}: tail_promote dt {dt!r} is not a finite "
+                    "non-negative number")
+            root = rec.get("root")
+            if not isinstance(root, str) or not root:
+                failures.append(
+                    f"{at}: tail_promote root {root!r} is not a "
+                    "non-empty string")
+        elif ev == "obs.summary":
+            aggs = rec.get("aggregates")
+            if not isinstance(aggs, dict):
+                continue
+            for name, agg in aggs.items():
+                ex = agg.get("exemplars") if isinstance(agg, dict) \
+                    else None
+                if ex is None:
+                    continue
+                n_forensics += 1
+                if not isinstance(ex, dict):
+                    failures.append(
+                        f"{at}: aggregate {name!r} exemplars is not "
+                        "an object")
+                    continue
+                for bucket, cell in ex.items():
+                    try:
+                        int(bucket)
+                    except (TypeError, ValueError):
+                        failures.append(
+                            f"{at}: aggregate {name!r} exemplar "
+                            f"bucket {bucket!r} is not an int key")
+                    if not isinstance(cell, dict):
+                        failures.append(
+                            f"{at}: aggregate {name!r} exemplar "
+                            f"{bucket!r} is not an object")
+                        continue
+                    t = cell.get("trace_id")
+                    if not isinstance(t, str) or not t:
+                        failures.append(
+                            f"{at}: aggregate {name!r} exemplar "
+                            f"{bucket!r} trace_id {t!r} is not a "
+                            "non-empty string")
+                    v = cell.get("value")
+                    if not _num(v) or not math.isfinite(v):
+                        failures.append(
+                            f"{at}: aggregate {name!r} exemplar "
+                            f"{bucket!r} value {v!r} is not a finite "
+                            "number")
+    if not n_forensics:
+        failures.append(
+            f"sink {path!r} has no forensics.* records or exemplar "
+            "blocks — were HPNN_SAMPLE / HPNN_CAPSULE_DIR armed "
+            "during this run?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1573,6 +1775,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_cluster(argv[i + 1])
+    if "--forensics" in argv:
+        i = argv.index("--forensics")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --forensics needs a "
+                             "path\n")
+            return 2
+        failures += lint_forensics(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

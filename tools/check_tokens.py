@@ -37,7 +37,10 @@ never touches the WAL, so an armed plan must stay inert here), the
 fleet telemetry plane (``HPNN_COLLECTOR`` pointed at a LIVE
 in-process collector on an ephemeral port, plus an ``HPNN_ALERTS``
 rule that actually fires on the round's own ``fuse.chunk_size``
-gauge — docs/observability.md "Fleet telemetry"), and a
+gauge — docs/observability.md "Fleet telemetry"), the tail-latency
+forensics plane (``HPNN_SAMPLE`` at rate 1 plus ``HPNN_CAPSULE_DIR``
+— the firing alert must pull the capture trigger and land a capsule
+manifest, while stdout stays frozen), and a
 live export server whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
@@ -222,10 +225,25 @@ def check(tmpdir: str) -> list[str]:
     os.environ["HPNN_COLLECTOR"] = f"http://127.0.0.1:{coll_port}"
     os.environ["HPNN_COLLECTOR_FLUSH_S"] = "0.05"
     os.environ["HPNN_ALERTS"] = "lint_chunk@fuse.chunk_size>0:cooldown=0"
+    # tail-latency forensics (docs/observability.md "Forensics") ride
+    # the same proof: the sampler armed at rate 1 (the train path has
+    # no request spans, so it must stay inert) plus a capsule dir the
+    # firing alert rule above must actually capture into — async, with
+    # the profiler window off so the capsule is just files
+    from hpnn_tpu.obs import forensics as forensics_mod
+    from hpnn_tpu.obs import triggers as triggers_mod
+
+    capsule_dir = os.path.join(tmpdir, "capsules")
+    os.environ["HPNN_SAMPLE"] = "1"
+    os.environ["HPNN_CAPSULE_DIR"] = capsule_dir
+    os.environ["HPNN_CAPSULE_PROFILE_MS"] = "0"
+    os.environ["HPNN_CAPSULE_COOLDOWN_S"] = "0"
     for knob, val in _ONLINE_KNOBS:
         os.environ[knob] = val
     chaos_mod._reset_for_tests()
     wal_mod._reset_for_tests()
+    forensics_mod._reset_for_tests()
+    triggers_mod._reset_for_tests()
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
@@ -234,11 +252,16 @@ def check(tmpdir: str) -> list[str]:
                      "HPNN_LEDGER", "HPNN_SPANS", "HPNN_COST",
                      "HPNN_SLO_MS", "HPNN_CHAOS", "HPNN_CHAOS_SEED",
                      "HPNN_WAL_DIR", "HPNN_COLLECTOR",
-                     "HPNN_COLLECTOR_FLUSH_S",
-                     "HPNN_ALERTS") + tuple(k for k, _ in _ONLINE_KNOBS):
+                     "HPNN_COLLECTOR_FLUSH_S", "HPNN_ALERTS",
+                     "HPNN_SAMPLE", "HPNN_CAPSULE_DIR",
+                     "HPNN_CAPSULE_PROFILE_MS",
+                     "HPNN_CAPSULE_COOLDOWN_S") \
+                + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
         chaos_mod._reset_for_tests()
         wal_mod._reset_for_tests()
+        forensics_mod._reset_for_tests()
+        triggers_mod._reset_for_tests()
 
     if plain != instrumented:
         failures.append(
@@ -246,7 +269,9 @@ def check(tmpdir: str) -> list[str]:
             "HPNN_FLIGHT + HPNN_PROBES + HPNN_NUMERICS + HPNN_LEDGER + "
             "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + HPNN_CHAOS + "
             "HPNN_WAL_DIR + HPNN_COLLECTOR (live push) + HPNN_ALERTS "
-            "(firing rule) + HPNN_ONLINE_* (incl. HPNN_ONLINE_SCAN_K) + "
+            "(firing rule) + HPNN_SAMPLE + HPNN_CAPSULE_DIR "
+            "(alert-triggered capture) + HPNN_ONLINE_* (incl. "
+            "HPNN_ONLINE_SCAN_K) + "
             "HPNN_SERVE_DTYPE=bf16 + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
     if os.path.exists(os.path.join(wal_dir, wal_mod.WAL_NAME)):
@@ -270,6 +295,36 @@ def check(tmpdir: str) -> list[str]:
         failures.append(
             "collector /fleetz lists no workers after a pushed round "
             f"(records_total={coll.records_total})")
+    # the firing alert rule must ALSO have pulled the capture trigger:
+    # an async capsule with a manifest must land under HPNN_CAPSULE_DIR
+    # (assembly runs on a daemon thread; give it the same grace the
+    # collector drain gets)
+    manifest_path = None
+    deadline = time.monotonic() + 5.0
+    while manifest_path is None and time.monotonic() < deadline:
+        for dirpath, _dirs, files in os.walk(capsule_dir):
+            if "manifest.json" in files:
+                manifest_path = os.path.join(dirpath, "manifest.json")
+                break
+        else:
+            time.sleep(0.05)
+    if manifest_path is None:
+        failures.append(
+            "no capture capsule landed with HPNN_CAPSULE_DIR + a "
+            "firing alert rule armed — the alert->capture hook is "
+            "dead")
+    else:
+        with open(manifest_path) as fp:
+            man = json.load(fp)
+        if not str(man.get("reason", "")).startswith("alert:"):
+            failures.append(
+                f"capsule manifest reason {man.get('reason')!r} is "
+                "not alert-attributed")
+        if "spans.jsonl" not in man.get("files", []):
+            failures.append(
+                "capsule manifest lists no spans.jsonl — the "
+                "sampler ring never reached the capsule")
+
     body = scraped.get("metrics", "")
     if "# TYPE" not in body or "hpnn_" not in body:
         failures.append(
@@ -561,7 +616,8 @@ def check(tmpdir: str) -> list[str]:
                  "fuse.chunk_size", "round.end", "obs.summary",
                  "device.live_arrays", "numerics.probe",
                  "numerics.checksum", "span.end", "compile.cost",
-                 "perf.flops_per_s", "alert.fire", "collector.push"):
+                 "perf.flops_per_s", "alert.fire", "collector.push",
+                 "forensics.capture"):
         if want not in names:
             failures.append(f"metrics sink missing event {want!r}")
     return failures

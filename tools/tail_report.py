@@ -1,0 +1,296 @@
+#!/usr/bin/env python3
+"""Slowest-N tail forensics over one or more obs sinks.
+
+``obs_report --spans`` renders every span tree; this tool answers the
+on-call question instead: *which requests were slowest, and which
+phase of the serving pipeline is to blame?*  It ingests the sinks the
+fleet already writes (worker ``HPNN_METRICS`` files and/or the
+collector's merged stream — several paths are joined with
+``obs_report.merge_events``'s skew-tolerant ordering), reconstructs
+the request span trees (sampled/promoted roots from ``HPNN_SAMPLE``
+work exactly like full ``HPNN_SPANS`` trees), and prints:
+
+* the **slowest-N request roots** (``serve.request`` /
+  ``cluster.request`` — outermost per trace), each with its per-phase
+  blame split;
+* the **aggregate blame** across every root — where the fleet's tail
+  time goes overall;
+* with ``--baseline``, a **paired comparison**: the same aggregates
+  over a second sink set and the per-phase delta, so "the regression
+  is queueing, not device time" is one command
+  (``tools/bench_gate.py`` is the CI twin for scalar metrics; this is
+  the forensic twin for phase attribution).
+
+Phase classification is by span name over the emitted tree:
+
+=============  ====================================================
+phase          span names
+=============  ====================================================
+queue          ``*.queue`` (batcher admission-to-pop wait)
+dispatch       ``*dispatch*`` (device forward, coalesced batch)
+spill          ``*spill*`` (host spill/reload traffic)
+shed_retry     any span that ended ``failed=Shed|QueueFull`` —
+               time burned on a rejected attempt before a retry
+other          any other instrumented descendant
+gap            root ``dt`` minus the subtree's covered time —
+               uninstrumented wall time: network hops, HTTP
+               parse, queue-to-thread handoff
+=============  ====================================================
+
+Each descendant is charged its **exclusive** time (its ``dt`` minus
+its own children's) so nested spans never double-count; the root's
+uncovered remainder is the ``gap``.
+
+Usage::
+
+    python tools/tail_report.py run.jsonl [more.jsonl ...]
+    python tools/tail_report.py run.jsonl --top 20 --root serve.request
+    python tools/tail_report.py run.jsonl --baseline before.jsonl
+    python tools/tail_report.py run.jsonl --json
+
+stdlib-only (rides tools/obs_report.py's loaders): the report must
+render on a login node with no jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+TOOLS = os.path.dirname(os.path.abspath(__file__))
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+import obs_report  # noqa: E402  (sibling tool, loaders reused)
+
+ROOT_NAMES = ("serve.request", "cluster.request")
+PHASES = ("queue", "dispatch", "spill", "shed_retry", "other", "gap")
+
+# rejected-attempt markers (serve/batcher.py raises, spans record the
+# exception class in the ``failed`` field)
+_SHED_FAILS = ("Shed", "QueueFull")
+
+
+def _phase_of(span: dict) -> str:
+    """Classify one descendant span into a blame phase by name (the
+    shed/retry check wins: a failed dispatch attempt is retry waste,
+    not useful device time)."""
+    if span["fields"].get("failed") in _SHED_FAILS:
+        return "shed_retry"
+    name = span["name"] or ""
+    if name.endswith(".queue") or ".queue" in name:
+        return "queue"
+    if "dispatch" in name:
+        return "dispatch"
+    if "spill" in name:
+        return "spill"
+    return "other"
+
+
+def load_spans(paths: list[str]) -> list[dict]:
+    """All spans from the given sinks, cross-process refs resolved
+    (several paths go through the skew-tolerant rank merge)."""
+    if len(paths) == 1:
+        events = obs_report.load_events(paths[0])
+    else:
+        events = obs_report.merge_events(paths)
+    return obs_report.collect_spans(events)
+
+
+def request_roots(spans: list[dict],
+                  root_names=ROOT_NAMES) -> list[dict]:
+    """The outermost request spans: named like a request root AND not
+    nested under another collected span (a ``serve.request`` under a
+    ``cluster.request`` blames into its parent, not the table)."""
+    by_ref = {s["ref"]: s for s in spans if s["ref"] is not None}
+    return [s for s in spans
+            if s["name"] in root_names
+            and by_ref.get(s["parent_ref"]) is None]
+
+
+def _descendants(root: dict, children_of: dict) -> list[dict]:
+    out: list[dict] = []
+    stack = [root]
+    while stack:
+        for child in children_of.get(stack.pop()["ref"], ()):
+            out.append(child)
+            stack.append(child)
+    return out
+
+
+def blame(root: dict, children_of: dict) -> dict:
+    """The per-phase wall-time split of one request root: exclusive
+    descendant time charged per phase, the uncovered remainder as
+    ``gap``.  Values in seconds; they sum to ``root['dt']`` up to
+    clock skew on remote children (each clamped at 0)."""
+    phases = {p: 0.0 for p in PHASES}
+    for d in _descendants(root, children_of):
+        kids = children_of.get(d["ref"], ())
+        exclusive = max(0.0, d["dt"] - sum(c["dt"] for c in kids))
+        phases[_phase_of(d)] += exclusive
+    covered = sum(phases.values())
+    phases["gap"] = max(0.0, root["dt"] - covered)
+    return phases
+
+
+def analyze(spans: list[dict], *, top: int = 10,
+            root_names=ROOT_NAMES) -> dict:
+    """The machine-form report: slowest-N roots with per-phase blame
+    plus the aggregate split over every root."""
+    children_of: dict = {}
+    by_ref = {s["ref"]: s for s in spans if s["ref"] is not None}
+    for s in spans:
+        parent = by_ref.get(s["parent_ref"])
+        if parent is not None and parent is not s:
+            children_of.setdefault(parent["ref"], []).append(s)
+    roots = request_roots(spans, root_names)
+    agg = {p: 0.0 for p in PHASES}
+    rows = []
+    for root in roots:
+        phases = blame(root, children_of)
+        for p, v in phases.items():
+            agg[p] += v
+        rows.append({
+            "name": root["name"],
+            "ref": root["ref"],
+            "dt": root["dt"],
+            "req_id": root["fields"].get("req_id"),
+            "trace": root["fields"].get("trace"),
+            "sampled": bool(root["fields"].get("sampled")),
+            "promoted": bool(root["fields"].get("promoted")),
+            "failed": root["fields"].get("failed"),
+            "phases": {p: round(v, 6) for p, v in phases.items()},
+        })
+    rows.sort(key=lambda r: -r["dt"])
+    total = sum(agg.values())
+    return {
+        "spans": len(spans),
+        "requests": len(roots),
+        "slowest": rows[:top],
+        "blame_total_s": {p: round(v, 6) for p, v in agg.items()},
+        "blame_pct": {p: round(100.0 * v / total, 2) if total else 0.0
+                      for p, v in agg.items()},
+    }
+
+
+def compare(rep: dict, base: dict) -> dict:
+    """The paired ``--baseline`` digest: per-phase percentage-point
+    shifts plus the mean-root-latency ratio — "what got slower, and
+    is the extra time queueing or device work"."""
+
+    def _mean(r):
+        n = r["requests"]
+        return (sum(r["blame_total_s"].values()) / n) if n else 0.0
+
+    mean_run, mean_base = _mean(rep), _mean(base)
+    return {
+        "requests": {"run": rep["requests"], "baseline": base["requests"]},
+        "mean_root_s": {"run": round(mean_run, 6),
+                        "baseline": round(mean_base, 6),
+                        "ratio": (round(mean_run / mean_base, 3)
+                                  if mean_base > 0 else None)},
+        "blame_pct_delta": {
+            p: round(rep["blame_pct"][p] - base["blame_pct"][p], 2)
+            for p in PHASES},
+    }
+
+
+def _fmt_phases(phases: dict, dt: float) -> str:
+    parts = []
+    for p in PHASES:
+        v = phases.get(p, 0.0)
+        if v <= 0.0:
+            continue
+        pct = 100.0 * v / dt if dt > 0 else 0.0
+        parts.append(f"{p} {pct:4.1f}%")
+    return "  ".join(parts)
+
+
+def render(rep: dict, cmp_doc: dict | None = None) -> str:
+    out: list[str] = []
+    w = out.append
+    w("== tail report ==")
+    w(f"spans: {rep['spans']}   request roots: {rep['requests']}")
+    if not rep["requests"]:
+        w("  (no request roots — was HPNN_SAMPLE or HPNN_SPANS set "
+          "on the serving path?)")
+        return "\n".join(out) + "\n"
+    w("")
+    w(f"-- slowest {len(rep['slowest'])} --")
+    w(f"  {'dt_ms':>9s} {'name':16s} {'req_id':>14s} {'trace':>17s}"
+      f"  blame")
+    for r in rep["slowest"]:
+        tag = ("P" if r["promoted"] else
+               "S" if r["sampled"] else " ")
+        flag = f" FAILED({r['failed']})" if r["failed"] else ""
+        w(f"  {r['dt'] * 1e3:9.3f} {r['name']:16s}"
+          f" {str(r['req_id'] or '-'):>14s}"
+          f" {str(r['trace'] or '-'):>17s} {tag}"
+          f" {_fmt_phases(r['phases'], r['dt'])}{flag}")
+    w("")
+    w("-- aggregate blame (all roots) --")
+    for p in PHASES:
+        w(f"  {p:10s} {rep['blame_total_s'][p]:10.6f} s"
+          f"  {rep['blame_pct'][p]:6.2f}%")
+    if cmp_doc is not None:
+        w("")
+        w("-- vs baseline --")
+        m = cmp_doc["mean_root_s"]
+        ratio = m["ratio"]
+        w(f"  roots: {cmp_doc['requests']['run']} vs "
+          f"{cmp_doc['requests']['baseline']} baseline")
+        w(f"  mean root: {m['run'] * 1e3:.3f} ms vs"
+          f" {m['baseline'] * 1e3:.3f} ms"
+          + (f"  ({ratio:.2f}x)" if ratio else ""))
+        for p in PHASES:
+            d = cmp_doc["blame_pct_delta"][p]
+            if abs(d) >= 0.01:
+                w(f"  {p:10s} {d:+6.2f} pp")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Slowest-N request table with per-phase blame "
+                    "over HPNN_METRICS sinks")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="metrics JSONL sink(s); several are merged "
+                         "into one skew-tolerant timeline")
+    ap.add_argument("--top", type=int, default=10, metavar="N",
+                    help="rows in the slowest table (default 10)")
+    ap.add_argument("--root", action="append", metavar="NAME",
+                    help="request-root span name(s) (default: "
+                         "serve.request + cluster.request)")
+    ap.add_argument("--baseline", nargs="+", metavar="path",
+                    help="baseline sink(s): append a paired "
+                         "comparison (phase blame deltas)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine form instead of text")
+    args = ap.parse_args(argv)
+    roots = tuple(args.root) if args.root else ROOT_NAMES
+    try:
+        rep = analyze(load_spans(args.paths), top=args.top,
+                      root_names=roots)
+        cmp_doc = None
+        if args.baseline:
+            base = analyze(load_spans(args.baseline), top=args.top,
+                           root_names=roots)
+            cmp_doc = compare(rep, base)
+    except OSError as exc:
+        sys.stderr.write(f"tail_report: {exc}\n")
+        return 1
+    if args.json:
+        doc = dict(rep)
+        if cmp_doc is not None:
+            doc["baseline"] = cmp_doc
+        json.dump(doc, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render(rep, cmp_doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
