@@ -25,6 +25,17 @@ Metric names are ``hpnn_`` + the event name with non-alphanumerics
 mapped to ``_`` (``driver.chunk_dispatch`` →
 ``hpnn_driver_chunk_dispatch``).
 
+The 0.0.4 body carries **no exemplars** — that format has no exemplar
+syntax, and even OpenMetrics forbids them on summary quantiles, so a
+suffixed body would fail a real Prometheus scrape.  A scraper that
+sends ``Accept: application/openmetrics-text`` instead gets
+:func:`render_openmetrics`: aggregates rendered as *histograms* with
+cumulative ``le`` buckets (the registry's log2 buckets verbatim),
+which is the line type OpenMetrics allows exemplars on — the tail
+sampler's ``# {trace_id="..."}`` marks (obs/forensics.py) ride the
+bucket samples there, and the document ends with the mandatory
+``# EOF``.
+
 ``/healthz`` here reports process-level health: registry state, uptime,
 plus whatever the drivers published through :func:`set_health` (the
 fused driver publishes ``last_round`` at round end/abort).  stdlib
@@ -43,6 +54,10 @@ from hpnn_tpu.obs import registry
 
 QUANTILES = (0.5, 0.9, 0.99)
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
 
 _health: dict = {}
 _health_lock = threading.Lock()
@@ -172,33 +187,81 @@ def render_prometheus(snap: dict | None) -> str:
         for q in QUANTILES:
             est = _quantile_estimate(agg, q)
             labels = _render_labels({"quantile": q})
-            lines.append(f"{m}{labels} {_fmt(est)}"
-                         + _exemplar_suffix(agg, est))
+            lines.append(f"{m}{labels} {_fmt(est)}")
         lines.append(f"{m}_sum {_fmt(agg['total'])}")
         lines.append(f"{m}_count {agg['n']}")
     return "\n".join(lines) + "\n"
 
 
-def _exemplar_suffix(agg: dict, est: float) -> str:
-    """The OpenMetrics-style exemplar suffix for one quantile line —
-    `` # {trace_id="..."} <value>`` — linking the bucket the estimate
-    lands in (or the nearest populated bucket below it) to the last
-    trace the tail sampler marked there (``registry.exemplar``).
-    Empty when the aggregate carries no exemplars."""
-    ex = agg.get("exemplars")
-    if not ex:
-        return ""
-    k = registry._bucket_of(est)
-    below = [int(b) for b in ex if int(b) <= k]
-    if not below:
-        return ""
-    e = ex[str(max(below))]
-    labels = _render_labels({"trace_id": e["trace_id"]})
-    return f" # {labels} {_fmt(e['value'])}"
+def render_openmetrics(snap: dict | None) -> str:
+    """The OpenMetrics 1.0 text exposition of one registry snapshot —
+    the variant negotiated by ``Accept: application/openmetrics-text``.
+    Aggregates render as **histograms** with cumulative ``le`` buckets
+    taken from the registry's log2 buckets (bucket ``k`` holds
+    ``(2^(k-1), 2^k]``, so its upper bound is ``2^k``; bucket 0 also
+    absorbs values ≤ 0), because bucket samples are the only aggregate
+    line type OpenMetrics allows exemplars on — the tail sampler's
+    ``# {trace_id="..."}`` marks attach to the bucket they landed in.
+    Ends with the mandatory ``# EOF`` terminator."""
+    lines = []
+    if snap is None:
+        lines.append("# hpnn obs registry inactive "
+                     "(set HPNN_METRICS or start an export server)")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+    lines.append("# TYPE hpnn_obs_uptime_seconds gauge")
+    lines.append(f"hpnn_obs_uptime_seconds {_fmt(snap['uptime_s'])}")
+    for ev, total in sorted(snap["counters"].items()):
+        m = _metric_name(ev)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m}_total {_fmt(total)}")
+    for ev, value in sorted(snap["gauges"].items()):
+        m = _metric_name(ev)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_fmt(value)}")
+    for ev, agg in sorted(snap["aggregates"].items()):
+        m = _metric_name(ev)
+        lines.append(f"# TYPE {m} histogram")
+        buckets = agg.get("log2_buckets") or {}
+        exemplars = agg.get("exemplars") or {}
+        cum = 0
+        for k in sorted(buckets, key=int):
+            cum += buckets[k]
+            line = f'{m}_bucket{{le="{_fmt(2.0 ** int(k))}"}} {cum}'
+            e = exemplars.get(str(int(k)))
+            if e:
+                labels = _render_labels({"trace_id": e["trace_id"]})
+                line += f" # {labels} {_fmt(e['value'])}"
+            lines.append(line)
+        lines.append(f'{m}_bucket{{le="+Inf"}} {agg["n"]}')
+        lines.append(f"{m}_sum {_fmt(agg['total'])}")
+        lines.append(f"{m}_count {agg['n']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def wants_openmetrics(accept: str | None) -> bool:
+    """Content negotiation for ``/metrics``: True when the scraper's
+    Accept header names the OpenMetrics media type."""
+    return bool(accept) and "application/openmetrics-text" in accept
+
+
+def metrics_response(accept: str | None = None) -> tuple[bytes, str]:
+    """The negotiated ``/metrics`` response for the current registry
+    state: ``(body, content_type)`` — exemplar-free 0.0.4 text by
+    default, the OpenMetrics histogram form (exemplars attached) when
+    the Accept header asks for it."""
+    snap = registry.snapshot_state()
+    if wants_openmetrics(accept):
+        return (render_openmetrics(snap).encode("utf-8"),
+                OPENMETRICS_CONTENT_TYPE)
+    return (render_prometheus(snap).encode("utf-8"),
+            TEXT_CONTENT_TYPE)
 
 
 def metrics_body() -> bytes:
-    """The ``/metrics`` response body for the current registry state."""
+    """The default (0.0.4) ``/metrics`` response body for the current
+    registry state."""
     return render_prometheus(registry.snapshot_state()).encode("utf-8")
 
 
@@ -221,8 +284,8 @@ class _ExportHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/metrics":
-            self._send(200, metrics_body(),
-                       "text/plain; version=0.0.4; charset=utf-8")
+            body, ctype = metrics_response(self.headers.get("Accept"))
+            self._send(200, body, ctype)
         elif self.path == "/healthz":
             body = json.dumps(health()).encode("utf-8")
             self._send(200, body, "application/json")

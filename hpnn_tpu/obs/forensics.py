@@ -77,9 +77,27 @@ _thr: float | None = None                # cached adaptive threshold
 _since_thr = 0                           # probes since last recompute
 
 
+def _knob(env: str, default: float, convert=float) -> float:
+    """Parse one secondary knob; a malformed value warns on stderr —
+    naming the actual offending variable — and falls back to its
+    documented default, leaving sampling armed."""
+    raw = os.environ.get(env, "")
+    if not raw:
+        return default
+    try:
+        return convert(raw)
+    except ValueError:
+        import sys
+
+        sys.stderr.write(f"hpnn obs: bad {env} value {raw!r}; "
+                         f"using default {default}\n")
+        return default
+
+
 def _config() -> dict | None:
     """Parse the knobs once; a malformed rate warns on stderr and
-    disarms (never a crash, never a stdout byte)."""
+    disarms, a malformed secondary knob warns and keeps its default
+    (never a crash, never a stdout byte)."""
     global _cfg, _ring
     c = _cfg
     if c is None:
@@ -93,15 +111,6 @@ def _config() -> dict | None:
                         rate = float(raw)
                         if not 0.0 < rate <= 1.0:
                             raise ValueError("rate outside (0, 1]")
-                        slow_ms = float(
-                            os.environ.get(ENV_SLOW_MS, "") or 0.0)
-                        ring_n = max(RING_FLOOR, int(
-                            os.environ.get(ENV_RING, "")
-                            or DEFAULT_RING))
-                        _cfg = {"rate": rate,
-                                "slow_s": max(0.0, slow_ms) / 1e3,
-                                "ring_n": ring_n}
-                        _ring = collections.deque(maxlen=ring_n)
                     except ValueError as exc:
                         import sys
 
@@ -109,6 +118,14 @@ def _config() -> dict | None:
                             f"hpnn obs: bad {ENV_KNOB} value "
                             f"{raw!r}: {exc}; sampling disabled\n")
                         _cfg = False
+                    else:
+                        slow_ms = _knob(ENV_SLOW_MS, 0.0)
+                        ring_n = max(RING_FLOOR, int(
+                            _knob(ENV_RING, DEFAULT_RING, int)))
+                        _cfg = {"rate": rate,
+                                "slow_s": max(0.0, slow_ms) / 1e3,
+                                "ring_n": ring_n}
+                        _ring = collections.deque(maxlen=ring_n)
             c = _cfg
     return c if c is not False else None
 
@@ -158,23 +175,29 @@ def request_span(name: str, **fields):
 def _threshold(cfg: dict) -> float:
     """The current slow-promotion threshold in seconds: the absolute
     floor when set, tightened by ring-p95 × factor once warmed up.
-    Recomputed every ``_THR_EVERY`` probes — never per request."""
+    Recomputed every ``_THR_EVERY`` probes — never per request.  The
+    ring is copied under ``_lock`` (request threads append to it under
+    the same lock — an unlocked sort would race the deque mutation and
+    crash an otherwise-successful request)."""
     global _thr, _since_thr
-    thr = _thr
-    if thr is None or _since_thr >= _THR_EVERY:
+    with _lock:
+        thr = _thr
+        if thr is not None and _since_thr < _THR_EVERY:
+            return thr
         ring = _ring
-        if ring is not None and len(ring) >= _WARMUP:
-            ordered = sorted(ring)
-            p95 = ordered[min(len(ordered) - 1,
-                              int(0.95 * len(ordered)))]
-            adaptive = p95 * _THR_FACTOR
-            thr = (min(adaptive, cfg["slow_s"]) if cfg["slow_s"] > 0
-                   else adaptive)
-        else:
-            thr = cfg["slow_s"] if cfg["slow_s"] > 0 else float("inf")
-        with _lock:
-            _thr = thr
-            _since_thr = 0
+        ordered = (sorted(ring)
+                   if ring is not None and len(ring) >= _WARMUP
+                   else None)
+    if ordered:
+        p95 = ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+        adaptive = p95 * _THR_FACTOR
+        thr = (min(adaptive, cfg["slow_s"]) if cfg["slow_s"] > 0
+               else adaptive)
+    else:
+        thr = cfg["slow_s"] if cfg["slow_s"] > 0 else float("inf")
+    with _lock:
+        _thr = thr
+        _since_thr = 0
     return thr
 
 
@@ -187,9 +210,14 @@ def _remember(sp, dt: float, promoted: bool) -> None:
     rec.update(sp.fields)
     if promoted:
         rec["promoted"] = True
-    _recent.append(rec)
+    with _lock:
+        _recent.append(rec)
     trace = sp.fields.get("trace")
     if trace:
+        # marks land only on aggregates something actually observes
+        # into (registry.exemplar is a no-op otherwise): the edge's
+        # own timer when it keeps one, plus the span.<name> summary
+        # spans.finish always feeds.
         registry.exemplar(sp.name, dt, trace)
         registry.exemplar("span." + sp.name, dt, trace)
 
@@ -240,8 +268,11 @@ def finish(sp, **fields) -> None:
 
 def recent_spans() -> list[dict]:
     """The last emitted roots (sampled + promoted), oldest first —
-    the ``spans.jsonl`` payload of a capture capsule."""
-    return list(_recent)
+    the ``spans.jsonl`` payload of a capture capsule.  Snapshot under
+    ``_lock``: the capsule thread iterates while request threads
+    append."""
+    with _lock:
+        return list(_recent)
 
 
 def health_doc() -> dict:
@@ -252,13 +283,14 @@ def health_doc() -> dict:
     with _lock:
         ring_len = len(_ring) if _ring is not None else 0
         thr = _thr
+        recent_n = len(_recent)
     return {
         "armed": True,
         "rate": cfg["rate"],
         "ring": ring_len,
         "slow_threshold_ms": (None if thr in (None, float("inf"))
                               else round(thr * 1e3, 3)),
-        "recent_spans": len(_recent),
+        "recent_spans": recent_n,
     }
 
 

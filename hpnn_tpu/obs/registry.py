@@ -380,17 +380,18 @@ def exemplar(name: str, value, trace: str) -> None:
     ``value`` — last-write-wins per bucket, rendered on ``/metrics``
     as ``# {trace_id="..."}`` suffixes (obs/export.py).  Called by the
     tail sampler (obs/forensics.py) when an emitted request span
-    carries a trace; a no-op when the registry is inactive or the
-    trace is empty."""
+    carries a trace; a no-op when the registry is inactive, the trace
+    is empty, or nothing has observed into the named aggregate yet —
+    minting an aggregate here would grow ``/metrics`` a degenerate
+    all-zero summary per marked name."""
     st = _active()
     if st is None or not trace:
         return
     v = float(value)
     with st.lock:
         agg = st.aggs.get(name)
-        if agg is None:
-            agg = st.aggs[name] = _Agg()
-        agg.mark(v, str(trace))
+        if agg is not None:
+            agg.mark(v, str(trace))
 
 
 def observe(name: str, values, **fields) -> None:

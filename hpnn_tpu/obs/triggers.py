@@ -173,7 +173,11 @@ def _profile_window(dirpath: str, ms: float) -> dict | None:
 def _assemble(path: str, reason: str, detail: dict | None,
               t0: float) -> dict:
     """Build the capsule artifacts + manifest (the slow half, off the
-    trigger path for alert captures).  Releases the in-flight slot."""
+    trigger path for alert captures).  The in-flight slot is released
+    in ``finally`` — an unexpected exception here (alert captures run
+    on a daemon thread nobody joins) must never leave
+    ``_in_flight=True`` forever, which would suppress every future
+    capture as ``in_flight``."""
     global _in_flight, _last_done
     cfg = _config() or {}
     errors: list[str] = []
@@ -187,63 +191,68 @@ def _assemble(path: str, reason: str, detail: dict | None,
         except OSError as exc:
             errors.append(f"{name}: {exc}")
 
-    # flight ring: dump to its own path, copy the file in
-    flight_path = None
-    dump = flight.dump(f"capsule:{reason}")
-    if dump:
-        try:
-            flight_path = os.path.join(path, "flight.jsonl")
-            shutil.copyfile(dump, flight_path)
-            files.append("flight.jsonl")
-        except OSError as exc:
-            flight_path = None
-            errors.append(f"flight.jsonl: {exc}")
+    try:
+        # flight ring: dump to its own path, copy the file in
+        flight_path = None
+        dump = flight.dump(f"capsule:{reason}")
+        if dump:
+            try:
+                flight_path = os.path.join(path, "flight.jsonl")
+                shutil.copyfile(dump, flight_path)
+                files.append("flight.jsonl")
+            except OSError as exc:
+                flight_path = None
+                errors.append(f"flight.jsonl: {exc}")
 
-    from hpnn_tpu.obs import export, forensics
+        from hpnn_tpu.obs import export, forensics
 
-    spans = forensics.recent_spans()
-    _write("spans.jsonl",
-           "".join(json.dumps(r) + "\n" for r in spans))
-    snap = registry.snapshot_state()
-    _write("gauges.json", json.dumps(snap, indent=1, default=str))
-    _write("health.json",
-           json.dumps(export.health(), indent=1, default=str))
+        spans = forensics.recent_spans()
+        _write("spans.jsonl",
+               "".join(json.dumps(r, default=str) + "\n"
+                       for r in spans))
+        snap = registry.snapshot_state()
+        _write("gauges.json", json.dumps(snap, indent=1, default=str))
+        _write("health.json",
+               json.dumps(export.health(), indent=1, default=str))
 
-    profile = _profile_window(os.path.join(path, "profile"),
-                              cfg.get("profile_ms", 0.0))
-    duration = time.monotonic() - t0
-    manifest = {
-        "reason": reason,
-        "ts": round(time.time(), 6),
-        "pid": os.getpid(),
-        "capsule": path,
-        "duration_s": round(duration, 6),
-        "files": sorted(files),
-        "spans": len(spans),
-        "flight": flight_path,
-        "profile": profile,
-    }
-    if detail:
-        manifest["alert"] = detail
-    if errors:
-        manifest["errors"] = errors
-    _write("manifest.json", json.dumps(manifest, indent=1))
-    registry.event("forensics.capture_done", reason=reason,
-                   capsule=path, duration_s=manifest["duration_s"],
-                   files=len(files), spans=len(spans),
-                   profile=profile is not None)
-    with _lock:
-        _in_flight = False
-        _last_done = time.monotonic()
-        _captures.append({
-            "reason": reason, "capsule": path,
-            "ts": manifest["ts"],
-            "duration_s": manifest["duration_s"],
-            "spans": manifest["spans"],
-            "profile": profile is not None,
-        })
-        del _captures[:-_MAX_KEPT]
-    return manifest
+        profile = _profile_window(os.path.join(path, "profile"),
+                                  cfg.get("profile_ms", 0.0))
+        duration = time.monotonic() - t0
+        manifest = {
+            "reason": reason,
+            "ts": round(time.time(), 6),
+            "pid": os.getpid(),
+            "capsule": path,
+            "duration_s": round(duration, 6),
+            "files": sorted(files),
+            "spans": len(spans),
+            "flight": flight_path,
+            "profile": profile,
+        }
+        if detail:
+            manifest["alert"] = detail
+        if errors:
+            manifest["errors"] = errors
+        _write("manifest.json",
+               json.dumps(manifest, indent=1, default=str))
+        registry.event("forensics.capture_done", reason=reason,
+                       capsule=path, duration_s=manifest["duration_s"],
+                       files=len(files), spans=len(spans),
+                       profile=profile is not None)
+        with _lock:
+            _captures.append({
+                "reason": reason, "capsule": path,
+                "ts": manifest["ts"],
+                "duration_s": manifest["duration_s"],
+                "spans": manifest["spans"],
+                "profile": profile is not None,
+            })
+            del _captures[:-_MAX_KEPT]
+        return manifest
+    finally:
+        with _lock:
+            _in_flight = False
+            _last_done = time.monotonic()
 
 
 def capture(reason: str, detail: dict | None = None) -> dict | None:

@@ -458,10 +458,10 @@ class _Handler(BaseHTTPRequestHandler):
             if not self._not_ready():
                 self._reply(200, self.session.ready_doc())
         elif self.path == "/metrics":
-            body = obs.export.metrics_body()
+            body, ctype = obs.export.metrics_response(
+                self.headers.get("Accept"))
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -71,6 +71,26 @@ def test_sampler_bad_rate_disarms_with_warning(monkeypatch, capsys):
     assert not forensics.enabled()
 
 
+def test_sampler_bad_secondary_knob_keeps_defaults(monkeypatch,
+                                                   capsys):
+    """A malformed HPNN_SAMPLE_RING / _SLOW_MS must not disarm a
+    valid rate: the warning names the offending variable and the knob
+    falls back to its documented default."""
+    monkeypatch.setenv("HPNN_SAMPLE", "0.5")
+    monkeypatch.setenv("HPNN_SAMPLE_RING", "many")
+    monkeypatch.setenv("HPNN_SAMPLE_SLOW_MS", "soon")
+    obs._reset_for_tests()
+    assert forensics.enabled()
+    doc = forensics.health_doc()
+    assert doc["armed"] and doc["rate"] == 0.5
+    cfg = forensics._config()
+    assert cfg["ring_n"] == forensics.DEFAULT_RING
+    assert cfg["slow_s"] == 0.0
+    err = capsys.readouterr().err
+    assert "HPNN_SAMPLE_RING" in err and "'many'" in err
+    assert "HPNN_SAMPLE_SLOW_MS" in err
+
+
 def test_sampled_request_emits_root_and_exemplar(tmp_path, monkeypatch):
     """rate=1 ⇒ every request gets a real span tree (sampled tag) and
     marks a histogram exemplar; the root lands in the capsule ring."""
@@ -83,8 +103,11 @@ def test_sampled_request_emits_root_and_exemplar(tmp_path, monkeypatch):
     assert rec["sampled"] is True
     assert forensics.recent_spans()[-1]["span"] == rec["span"]
     snap = obs.snapshot_state()
-    ex = snap["aggregates"]["serve.request"]["exemplars"]
+    ex = snap["aggregates"]["span.serve.request"]["exemplars"]
     assert any(v["trace_id"] == "tr1" for v in ex.values())
+    # the bare name has no timer feeding it here, so no degenerate
+    # all-zero aggregate may be minted for the exemplar alone
+    assert "serve.request" not in snap["aggregates"]
     assert forensics.health_doc()["recent_spans"] >= 1
 
 
@@ -131,16 +154,33 @@ def test_exemplar_noop_when_inactive_or_traceless(monkeypatch):
     assert not agg.get("exemplars")
 
 
-def test_metrics_render_carries_exemplar_suffix(tmp_path, monkeypatch):
+def test_metrics_exemplars_need_openmetrics_negotiation(tmp_path,
+                                                        monkeypatch):
+    """The default 0.0.4 body must stay exemplar-free (the format has
+    no exemplar syntax — a suffix breaks real Prometheus scrapes);
+    the negotiated OpenMetrics body carries the mark on the histogram
+    bucket line it landed in and terminates with ``# EOF``."""
     from hpnn_tpu.obs import export
 
     _arm(monkeypatch, tmp_path, HPNN_SAMPLE="1")
     obs.observe("serve.request", [0.01, 0.02, 0.04])
     registry.exemplar("serve.request", 0.04, "abc123")
-    text = export.render_prometheus(obs.snapshot_state())
-    tagged = [ln for ln in text.splitlines()
+    snap = obs.snapshot_state()
+    text = export.render_prometheus(snap)
+    assert " # {" not in text
+    om = export.render_openmetrics(snap)
+    tagged = [ln for ln in om.splitlines()
               if ' # {trace_id="abc123"} ' in ln]
-    assert tagged and 'quantile=' in tagged[0]
+    assert tagged and 'le=' in tagged[0] and "_bucket" in tagged[0]
+    assert om.endswith("# EOF\n")
+    # negotiation: the Accept header picks the body + content type
+    assert not export.wants_openmetrics("text/plain")
+    body, ctype = export.metrics_response("application/openmetrics-text")
+    assert ctype == export.OPENMETRICS_CONTENT_TYPE
+    assert b'trace_id="abc123"' in body
+    body, ctype = export.metrics_response(None)
+    assert ctype == export.TEXT_CONTENT_TYPE
+    assert b" # {" not in body
 
 
 # ------------------------------------------------------------ capsules
@@ -192,6 +232,40 @@ def test_capsule_paths_never_reused(tmp_path, monkeypatch):
     _sink, _capdir = _arm_capsules(monkeypatch, tmp_path)
     paths = {triggers.capture("unit")["capsule"] for _ in range(3)}
     assert len(paths) == 3
+
+
+def test_capsule_assembly_crash_releases_in_flight(tmp_path,
+                                                   monkeypatch):
+    """An unexpected exception mid-assembly must not wedge the
+    at-most-one-in-flight slot forever — the alert path assembles on
+    a daemon thread nobody joins, so a leaked slot would silently
+    suppress every future capture as ``in_flight``."""
+    _sink, _capdir = _arm_capsules(monkeypatch, tmp_path)
+
+    def _boom(_reason):
+        raise RuntimeError("flight ring exploded")
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(triggers.flight, "dump", _boom)
+        with pytest.raises(RuntimeError):
+            triggers.capture("unit")
+    assert not triggers.health_doc()["in_flight"]
+    assert triggers.capture("unit") is not None   # slot released
+
+
+def test_capsule_spans_survive_nonserializable_field(tmp_path,
+                                                     monkeypatch):
+    """spans.jsonl dumps with ``default=str`` — an exotic span field
+    (anything the sink's own ``_to_py`` stringified) must not kill the
+    capsule assembly."""
+    _sink, _capdir = _arm_capsules(monkeypatch, tmp_path)
+    sp = forensics.request_span("serve.request", trace="tr9",
+                                blob=object())
+    forensics.finish(sp)
+    man = triggers.capture("unit")
+    assert man is not None and "spans.jsonl" in man["files"]
+    ring = _read(os.path.join(man["capsule"], "spans.jsonl"))
+    assert ring[0]["name"] == "serve.request"
 
 
 def test_http_capture_status_codes(tmp_path, monkeypatch):

@@ -367,10 +367,12 @@ def test_export_label_escaping_round_trip(tmp_path, monkeypatch):
     assert rendered.startswith("{") and rendered.endswith("}")
     assert export._render_labels({}) == ""
 
-    # full exposition round trip: render a live snapshot — with a
-    # tail-sampler exemplar marked, so quantile lines carry the
-    # `` # {trace_id="..."} v`` suffix — and parse every sample line
-    # back per the 0.0.4 grammar
+    # full exposition round trip: the default 0.0.4 body must stay
+    # exemplar-free (that format has no exemplar syntax — a suffix
+    # breaks real Prometheus scrapes); the negotiated OpenMetrics
+    # body carries the tail-sampler mark on a histogram bucket line.
+    # Parse every sample line of both back, worst-case trace id
+    # included.
     import re
 
     from hpnn_tpu.obs import registry
@@ -381,17 +383,37 @@ def test_export_label_escaping_round_trip(tmp_path, monkeypatch):
     obs.observe("unit.lat", [1.0, 2.0])
     trace = 'tr"ace\r1'                     # worst-case id round-trips
     registry.exemplar("unit.lat", 2.0, trace)
-    text = export.render_prometheus(obs.snapshot_state())
-    assert "hpnn_perf_mfu 0.25" in text
+    snap = obs.snapshot_state()
     sample = re.compile(
         r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
         r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
         r' (-?[0-9.eE+-]+|NaN)'
         r'(?: # \{trace_id="((?:[^"\\]|\\.)*)"\} (-?[0-9.eE+-]+|NaN))?$')
-    parsed = exemplars = 0
+
+    text = export.render_prometheus(snap)
+    assert "hpnn_perf_mfu 0.25" in text
+    parsed = 0
     for line in text.strip().splitlines():
         if line.startswith("#"):
             assert line.startswith("# TYPE "), line
+            continue
+        m = sample.match(line)
+        assert m, line
+        assert m.group(4) is None, line     # 0.0.4: never an exemplar
+        float(m.group(3))
+        for lab in re.finditer(r'="((?:[^"\\]|\\.)*)"',
+                               m.group(2) or ""):
+            _parse_label_value(lab.group(1))
+        parsed += 1
+    assert parsed >= 5
+
+    om = export.render_openmetrics(snap)
+    assert om.endswith("# EOF\n")
+    parsed = exemplars = 0
+    for line in om.strip().splitlines():
+        if line.startswith("#"):
+            assert (line.startswith("# TYPE ")
+                    or line == "# EOF"), line
             continue
         m = sample.match(line)
         assert m, line
@@ -400,6 +422,7 @@ def test_export_label_escaping_round_trip(tmp_path, monkeypatch):
                                m.group(2) or ""):
             _parse_label_value(lab.group(1))
         if m.group(4) is not None:
+            assert m.group(1).endswith("_bucket")   # legal carrier
             assert _parse_label_value(m.group(4)) == trace
             assert float(m.group(5)) == 2.0
             exemplars += 1
