@@ -723,6 +723,112 @@ def bench_sampler_overhead(iters: int = 200, repeats: int = 5):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_drift_overhead(iters: int = 200, repeats: int = 5):
+    """Paired measurement of the drift plane's MARGINAL cost on the
+    two hot paths it taps: the same ``Session.infer`` +
+    ``SampleBuffer.feed`` loop with the JSONL sink armed in BOTH
+    legs, plus — in the "on" leg only — ``HPNN_DRIFT=1`` (every
+    dispatch folded into the prediction sketch, every feed into the
+    ingest sketch).  Quantifies the claim that armed sketches are
+    affordable on the hot path (docs/observability.md "Drift
+    detection"; tools/bench_gate.py gates ``drift_overhead_pct``)."""
+    from hpnn_tpu import obs, serve
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.online import ingest as ingest_mod
+
+    prev_sink = obs.sink_path() if obs.enabled() else None
+    d = tempfile.mkdtemp(prefix="hpnn_drift_bench_")
+    saved = {k: os.environ.pop(k, None)
+             for k in ("HPNN_DRIFT", "HPNN_DRIFT_WINDOW",
+                       "HPNN_DRIFT_Z")}
+
+    def arm(on: bool, sink: str) -> None:
+        # the drift memo caches the armed config, so each leg resets
+        # it; the small window makes the sketches actually SCORE
+        # inside a leg (reference frozen at 64 rows, live scoring
+        # from row 80 of the 200)
+        if on:
+            os.environ["HPNN_DRIFT"] = "1"
+            os.environ["HPNN_DRIFT_WINDOW"] = "64"
+        else:
+            os.environ.pop("HPNN_DRIFT", None)
+            os.environ.pop("HPNN_DRIFT_WINDOW", None)
+        obs.drift._reset_for_tests()
+        obs.configure(sink)
+
+    n_in, n_hid, n_out = FLEET_SHAPE
+    kern = kernel_mod.generate(4242, n_in, [n_hid], n_out)[0]
+    rng = np.random.RandomState(2)
+    Xs = rng.normal(size=(iters, n_in))
+    t = np.full(n_out, -1.0)
+    t[0] = 1.0
+    sess = None
+    try:
+        sess = serve.Session(max_batch=8, n_buckets=2,
+                             max_wait_ms=0.5)
+        sess.register_kernel("bench", kern)
+        buf = ingest_mod.SampleBuffer(capacity=max(64, iters))
+
+        def leg() -> None:
+            for i in range(iters):
+                sess.infer("bench", Xs[i])
+                buf.feed(Xs[i], t)
+
+        # warm both legs (compile, sink open, drift memo)
+        arm(False, os.path.join(d, "warm_off.jsonl"))
+        leg()
+        arm(True, os.path.join(d, "warm_on.jsonl"))
+        leg()
+
+        on_s, off_s = [], []
+        for r in range(repeats):
+            arm(False, os.path.join(d, f"off{r}.jsonl"))
+            t0 = time.perf_counter()
+            leg()
+            off_s.append(time.perf_counter() - t0)
+            arm(True, os.path.join(d, f"on{r}.jsonl"))
+            t0 = time.perf_counter()
+            leg()
+            on_s.append(time.perf_counter() - t0)
+        obs.configure(None)  # close the last sink so the scan below
+        # is over flushed bytes
+
+        # the proof the "on" leg actually sketched: the last on-leg
+        # must carry drift gauges from both taps
+        scored = {"pred": 0, "ingest": 0}
+        with open(os.path.join(d, f"on{repeats - 1}.jsonl")) as fp:
+            for ln in fp:
+                scored["pred"] += '"drift.pred_shift"' in ln
+                scored["ingest"] += ('"drift.score"' in ln
+                                     and '"ingest"' in ln)
+        deltas = [round(100.0 * (a - b) / b, 2)
+                  for a, b in zip(on_s, off_s)]
+        return {
+            "iters": iters,
+            "loop_s_drift_off": _stats([round(v, 4) for v in off_s]),
+            "loop_s_drift_on": _stats([round(v, 4) for v in on_s]),
+            "paired_overhead_pct": {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            },
+            "drift_gauges_last_round": scored,
+        }
+    finally:
+        if sess is not None:
+            sess.close()
+        obs.configure(None)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        from hpnn_tpu.obs import drift as _drift_mod
+
+        _drift_mod._reset_for_tests()
+        obs.configure(prev_sink)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 FLEET_MEMBERS = 64
 FLEET_SHAPE = (32, 16, 4)   # HPNN-sized: the paper's natural workload
 FLEET_TICKS = 30
@@ -1112,6 +1218,15 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["sampler_overhead_error"] = repr(exc)
 
+    # drift-sketch overhead: the same paired shape on the serve +
+    # ingest hot paths, HPNN_DRIFT=1 in one leg (docs/observability.md
+    # "Drift detection") — rides the same skip knob, best-effort
+    if not os.environ.get("HPNN_BENCH_NO_OBS_OVERHEAD"):
+        try:
+            out["drift_overhead"] = bench_drift_overhead()
+        except Exception as exc:
+            out["drift_overhead_error"] = repr(exc)
+
     # HPNN_METRICS: the bench subprocesses/rounds inherit the knob, so
     # the run's structured events land in the sink — record where, and
     # fold obs_report's machine summary in (best-effort: a torn sink
@@ -1300,6 +1415,23 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["capsule_drill_error"] = repr(exc)
 
+    # Drift drill (tools/chaos_drill.py run_bench_drift_drill): learn
+    # a clean label-shifted-MNIST stream, arm the sketches on the
+    # converged plateau, shift the labels under live load, and prove
+    # the sentinel breaches, the drift alert fires, and the capture
+    # capsule lands with drift.json — while serving keeps answering
+    # (docs/observability.md "Drift detection").  Rides the same
+    # HPNN_BENCH_NO_DRILL knob (in-process, tens of seconds).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["drift_drill"] = chaos_drill.run_bench_drift_drill()
+        except Exception as exc:
+            out["drift_drill_error"] = repr(exc)
+
     # Autoscale ramp (tools/bench_autoscale.py): a loadgen ramp past
     # the single-worker plateau that the SLO-driven autoscaler rides —
     # width 1→N under overdrive, windowed goodput vs the plateau,
@@ -1433,6 +1565,12 @@ def main(argv=None) -> None:
         cd = out["capsule_drill"]
         compact["drill_capsule_capture_s"] = cd["capture_s"]
         compact["drill_capsule_blame_pct"] = cd["dispatch_blame_pct"]
+    if ("drift_drill" in out
+            and out["drift_drill"].get("detect_s") is not None):
+        dd = out["drift_drill"]
+        compact["drill_drift_detect_s"] = dd["detect_s"]
+        compact["drill_drift_rounds"] = dd["rounds_to_detect"]
+        compact["drill_drift_lost"] = dd["lost"]
     if ("autoscale" in out
             and out["autoscale"].get("goodput_x") is not None):
         asc = out["autoscale"]
@@ -1451,6 +1589,10 @@ def main(argv=None) -> None:
     if "sampler_overhead" in out:
         compact["sampler_overhead_pct"] = (
             out["sampler_overhead"]["paired_overhead_pct"]["median"]
+        )
+    if "drift_overhead" in out:
+        compact["drift_overhead_pct"] = (
+            out["drift_overhead"]["paired_overhead_pct"]["median"]
         )
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:

@@ -399,6 +399,16 @@ KNOBS = {
     "HPNN_CAPSULE_COOLDOWN_S": {
         "default": 30, "doc": "docs/observability.md",
         "desc": "minimum seconds between finished captures"},
+    # --- drift detection (docs/observability.md) ---
+    "HPNN_DRIFT": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "arm streaming drift detection (sketches + sentinel)"},
+    "HPNN_DRIFT_WINDOW": {
+        "default": 128, "doc": "docs/observability.md",
+        "desc": "drift reference/live window size in rows (floor 16)"},
+    "HPNN_DRIFT_Z": {
+        "default": 3.0, "doc": "docs/observability.md",
+        "desc": "decay-sentinel EWMA z-score breach threshold"},
     # --- chaos / durability (docs/resilience.md) ---
     "HPNN_CHAOS": {
         "default": None, "doc": "docs/resilience.md",

@@ -415,6 +415,7 @@ class ClusterRouter:
         doc["alerts"] = obs.alerts.health_doc()
         doc["sampler"] = obs.forensics.health_doc()
         doc["capsules"] = obs.triggers.health_doc()
+        doc["drift"] = obs.drift.health_doc()
         if self.online_health is not None:
             doc["online"] = self.online_health()
         return doc

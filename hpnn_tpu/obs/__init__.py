@@ -64,7 +64,13 @@ structured side channel next to it:
   spans, gauges, ``/healthz``, and a bounded programmatic
   ``jax.profiler`` trace window — ``HPNN_CAPSULE_DIR``
   (obs/triggers.py; slowest-N phase-blame analysis:
-  ``tools/tail_report.py``).
+  ``tools/tail_report.py``);
+* the drift observability plane: ingest-stream quantile sketches,
+  per-kernel prediction-shift histograms, and a held-out decay
+  sentinel over the resident kernel's eval loss — normalized
+  ``drift.score`` gauges, ``online.drift`` events, and a
+  ``drift.json`` capsule artifact — ``HPNN_DRIFT``
+  (obs/drift.py; drill: ``tools/chaos_drill.py --drill drift``).
 
 Typical instrumentation site::
 
@@ -81,9 +87,10 @@ discipline, swallowed exceptions): ``tools/hpnnlint``,
 docs/analysis.md.
 """
 
-from hpnn_tpu.obs import (alerts, collector, cost, device, export,
-                          flight, forensics, ledger, lockwatch,
-                          probes, propagate, slo, spans, triggers)
+from hpnn_tpu.obs import (alerts, collector, cost, device, drift,
+                          export, flight, forensics, ledger,
+                          lockwatch, probes, propagate, slo, spans,
+                          triggers)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -112,6 +119,7 @@ __all__ = [
     "cost",
     "count",
     "device",
+    "drift",
     "enabled",
     "event",
     "export",

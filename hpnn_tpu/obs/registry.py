@@ -234,7 +234,8 @@ def _init():
                     or os.environ.get("HPNN_COLLECTOR")
                     or os.environ.get("HPNN_ALERTS")
                     or os.environ.get("HPNN_SAMPLE")
-                    or os.environ.get("HPNN_CAPSULE_DIR")):
+                    or os.environ.get("HPNN_CAPSULE_DIR")
+                    or os.environ.get("HPNN_DRIFT")):
                 _state = False
                 return False
             path = None
@@ -595,6 +596,7 @@ def _reset_for_tests() -> None:
                  "hpnn_tpu.obs.propagate", "hpnn_tpu.obs.collector",
                  "hpnn_tpu.obs.alerts", "hpnn_tpu.obs.lockwatch",
                  "hpnn_tpu.obs.forensics", "hpnn_tpu.obs.triggers",
+                 "hpnn_tpu.obs.drift",
                  "hpnn_tpu.chaos", "hpnn_tpu.online.wal"):
         mod = sys.modules.get(name)
         if mod is not None:

@@ -15,6 +15,9 @@ moment of the fire:
                         (obs/forensics.py ring)
         gauges.json     the cumulative registry snapshot
         health.json     the process /healthz document
+        drift.json      reference + live drift sketches, scores, and
+                        window bounds (when HPNN_DRIFT armed;
+                        obs/drift.py)
         profile/        an on-demand ``jax.profiler`` trace window
                         (start_trace/stop_trace, bounded by
                         ``HPNN_CAPSULE_PROFILE_MS``; absent when jax
@@ -204,7 +207,7 @@ def _assemble(path: str, reason: str, detail: dict | None,
                 flight_path = None
                 errors.append(f"flight.jsonl: {exc}")
 
-        from hpnn_tpu.obs import export, forensics
+        from hpnn_tpu.obs import drift, export, forensics
 
         spans = forensics.recent_spans()
         _write("spans.jsonl",
@@ -214,6 +217,13 @@ def _assemble(path: str, reason: str, detail: dict | None,
         _write("gauges.json", json.dumps(snap, indent=1, default=str))
         _write("health.json",
                json.dumps(export.health(), indent=1, default=str))
+        sketches = drift.sketch_doc()
+        if sketches is not None:
+            # the distribution at the moment it moved: reference +
+            # live sketch dump, scores, window bounds (obs/drift.py;
+            # absent when HPNN_DRIFT is unarmed)
+            _write("drift.json",
+                   json.dumps(sketches, indent=1, default=str))
 
         profile = _profile_window(os.path.join(path, "profile"),
                                   cfg.get("profile_ms", 0.0))

@@ -133,6 +133,10 @@ class SampleBuffer:
         if dropped:
             obs.count("online.drop", dropped)
         obs.gauge("online.buffer_depth", depth)
+        if obs.drift.enabled():
+            # ingest-sketch tap (obs/drift.py): outside the lock —
+            # scoring fans into the alert engine on breach
+            obs.drift.note_ingest(X)
         return accepted
 
     # ------------------------------------------------------------ census

@@ -15,6 +15,18 @@ downloads and replays bit-identically per seed:
   the pdif story as a stream.
 
 ``take(stream, n)`` collects a block — handy for seeding eval sets.
+
+Nonstationary wrappers (the drift drill's traffic source,
+``tools/chaos_drill.py --drill drift``, and the ROADMAP item 4
+scenario-suite seed): :func:`label_shift` remaps the one-hot targets
+after ``at`` samples (annotation / class-prior shift — the inputs
+keep flowing unchanged, the labels lie, and only a held-out decay
+sentinel can see it); :func:`rotate` rotates the *inputs* after
+``at`` samples (covariate shift — square images rotate about their
+centre, 1-D spectra phase-roll), which moves the ingest sketches and
+the prediction histograms (obs/drift.py).  Both are deterministic,
+pure functions of the underlying stream: same seed, same shifted
+replay.
 """
 
 from __future__ import annotations
@@ -69,6 +81,76 @@ def xrd_stream(seed: int = 0, *, n_in: int = 128, classes: int = 8):
         if peak > 0:
             x = x / peak
         yield x.astype(np.float64), _one_hot(cls, int(classes))
+
+
+def label_shift(stream, at: int, mapping):
+    """Wrap ``stream`` so that from sample ``at`` onwards every
+    one-hot target's class ``c`` is remapped to ``mapping[c]``
+    (dict or sequence; classes absent from a dict mapping pass
+    through).  The inputs are untouched — this is annotation /
+    class-prior shift, the drift mode only a held-out quality signal
+    can catch.  Deterministic: a pure function of the wrapped
+    stream."""
+    at = int(at)
+    remap = (dict(mapping) if isinstance(mapping, dict)
+             else {i: m for i, m in enumerate(mapping)})
+    remap = {int(k): int(v) for k, v in remap.items()}
+
+    def _gen():
+        for i, (x, t) in enumerate(stream):
+            if i >= at:
+                cls = int(np.argmax(t))
+                t = _one_hot(remap.get(cls, cls), t.shape[0])
+            yield x, t
+
+    return _gen()
+
+
+def _rotate_square(x: np.ndarray, side: int, angle: float):
+    """Nearest-neighbour rotation of a flattened ``side x side``
+    image about its centre (pixels mapped from outside the frame are
+    zero) — no scipy, bit-stable across runs."""
+    img = x.reshape(side, side)
+    th = np.deg2rad(float(angle))
+    c, s = np.cos(th), np.sin(th)
+    ctr = (side - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(side), np.arange(side),
+                         indexing="ij")
+    # inverse map: source coordinates for each destination pixel
+    ys = c * (yy - ctr) + s * (xx - ctr) + ctr
+    xs = -s * (yy - ctr) + c * (xx - ctr) + ctr
+    yi = np.rint(ys).astype(np.int64)
+    xi = np.rint(xs).astype(np.int64)
+    ok = (yi >= 0) & (yi < side) & (xi >= 0) & (xi < side)
+    out = np.zeros_like(img)
+    out[yy[ok], xx[ok]] = img[yi[ok], xi[ok]]
+    return out.reshape(-1)
+
+
+def rotate(stream, at: int, angle: float):
+    """Wrap ``stream`` so that from sample ``at`` onwards every input
+    is rotated by ``angle`` degrees: flattened square images (e.g.
+    :func:`mnist_stream`'s 784 = 28x28 pixels) rotate about the image
+    centre with nearest-neighbour resampling; non-square vectors
+    (e.g. :func:`xrd_stream` spectra) circular-shift by
+    ``angle/360`` of their length — a phase roll.  Targets are
+    untouched — this is covariate shift, visible to the ingest
+    sketches and the prediction histograms.  Deterministic: a pure
+    function of the wrapped stream."""
+    at = int(at)
+
+    def _gen():
+        for i, (x, t) in enumerate(stream):
+            if i >= at:
+                side = int(round(np.sqrt(x.shape[0])))
+                if side * side == x.shape[0] and side >= 2:
+                    x = _rotate_square(x, side, angle)
+                else:
+                    x = np.roll(
+                        x, int(round(x.shape[0] * angle / 360.0)))
+            yield x, t
+
+    return _gen()
 
 
 def take(stream, n: int):

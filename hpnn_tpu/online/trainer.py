@@ -30,7 +30,11 @@ rounds — see docs/performance.md).
 
 Observability: ``online.round`` events, ``online.train_round`` spans,
 ``online.train_loss`` / ``online.staleness_s`` gauges,
-``online.round_failed`` counts.  Catalog: docs/online.md.
+``online.round_failed`` counts — and, every round (starved rounds
+included), ``online.eval_resident``: the held-out loss of the
+*currently-serving* weights, the quality signal the promote-gated
+path structurally misses and the input of the drift plane's decay
+sentinel (``HPNN_DRIFT``, obs/drift.py).  Catalog: docs/online.md.
 """
 
 from __future__ import annotations
@@ -196,6 +200,35 @@ class OnlineTrainer:
         return {entry.name: (cand,
                              float(np.asarray(losses)[-1].mean()))}
 
+    def _eval_resident(self, names) -> None:
+        """Score the *currently-serving* weights on the held-out set
+        and record ``online.eval_resident`` — every round, starved
+        rounds included.  The promote-gated gauges only speak when a
+        candidate is judged, so a drifting stream that degrades the
+        resident without producing a winner is otherwise invisible;
+        this is the decay sentinel's input (obs/drift.py)."""
+        eval_set = (self.eval_set if self.eval_set is not None
+                    else self.buffer.eval_snapshot())
+        if eval_set is None or len(eval_set[0]) < 1:
+            return
+        from hpnn_tpu.online import promote
+
+        for name in names:
+            try:
+                entry = self.session.registry.get(name)
+                loss = promote.eval_loss(entry.kernel.weights,
+                                         eval_set[0], eval_set[1],
+                                         model=entry.model)
+            # hpnnlint: ignore[swallow] -- counted; one bad eval must
+            except Exception as exc:  # not kill the trainer round
+                obs.count("online.eval_resident_failed", kernel=name,
+                          error=type(exc).__name__)
+                continue
+            obs.gauge("online.eval_resident", round(float(loss), 9),
+                      kernel=name)
+            if obs.drift.enabled():
+                obs.drift.note_eval(name, loss)
+
     def round_once(self) -> dict:
         """One trainer round; returns its summary (also emitted as the
         ``online.round`` event)."""
@@ -204,6 +237,7 @@ class OnlineTrainer:
         if staleness is not None:
             obs.gauge("online.staleness_s", round(staleness, 6))
         obs.gauge("online.buffer_depth", self.buffer.depth())
+        self._eval_resident(names)
         summary = {"round": self._round, "trained": 0, "promoted": 0,
                    "rejected": 0, "rolled_back": 0,
                    "outcomes": {}}

@@ -565,6 +565,10 @@ class Engine:
             # numpy) into the per-kernel /healthz numerics verdict
             obs.probes.note_serve(entry_name, rows=int(out.shape[0]),
                                   nan=int(np.isnan(out).sum()))
+        if obs.drift.enabled():
+            # prediction-drift tap (obs/drift.py): host-side outputs
+            # only — the compiled graph is never touched
+            obs.drift.note_pred(entry_name, out)
         results = []
         start = 0
         for c in counts:
@@ -746,6 +750,8 @@ class Engine:
                 if obs.probes.enabled():
                     obs.probes.note_serve(
                         m, rows=got, nan=int(np.isnan(out).sum()))
+                if obs.drift.enabled():
+                    obs.drift.note_pred(m, out)
                 start = 0
                 for i in by_name[m]:
                     c = named[i][1].shape[0]

@@ -1,0 +1,299 @@
+"""Drift observability plane (obs/drift.py): ingest/prediction
+sketches, the held-out decay sentinel, the streams shift wrappers
+(online/streams.py), and the capsule ``drift.json`` artifact.
+
+The plane's contract is the usual obs one — ``HPNN_DRIFT`` unset ⇒
+constant-time no-ops, not one record — plus its own: normalized
+``drift.score`` gauges (1.0 = breach) per (detector, kernel) series,
+exactly one ``online.drift`` event per rising edge of the breach
+bound, and a full reference+live sketch dump in every capture
+capsule taken while armed."""
+
+import json
+import math
+import os
+
+import numpy as np
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import drift, triggers
+from hpnn_tpu.online import streams
+from hpnn_tpu.online.session import OnlineSession
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _arm(monkeypatch, tmp_path, window=16, z=3.0):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.setenv("HPNN_DRIFT", "1")
+    monkeypatch.setenv("HPNN_DRIFT_WINDOW", str(window))
+    monkeypatch.setenv("HPNN_DRIFT_Z", str(z))
+    obs._reset_for_tests()
+    return sink
+
+
+def _rows(rng, n, loc=0.0, n_in=4):
+    return rng.normal(loc=loc, size=(n, n_in))
+
+
+# ------------------------------------------------------------ unarmed
+def test_unarmed_everything_noops(monkeypatch, tmp_path):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.delenv("HPNN_DRIFT", raising=False)
+    obs._reset_for_tests()
+    assert not drift.enabled()
+    rng = np.random.RandomState(0)
+    drift.note_ingest(_rows(rng, 64))
+    drift.note_pred("k", _rows(rng, 64))
+    drift.note_eval("k", 0.5)
+    assert drift.sketch_doc() is None
+    assert drift.health_doc() == {"armed": False}
+    obs.flush()
+    if os.path.exists(sink):
+        assert not [r for r in _read(sink)
+                    if str(r.get("ev", "")).startswith("drift.")]
+
+
+def test_config_floor_and_bad_knob_fallback(monkeypatch, tmp_path,
+                                            capsys):
+    _arm(monkeypatch, tmp_path, window=4)
+    cfg = drift._config()
+    assert cfg["window"] == drift.WINDOW_FLOOR
+    assert cfg["min_rows"] == 8
+    monkeypatch.setenv("HPNN_DRIFT_Z", "not-a-number")
+    drift._reset_for_tests()
+    assert drift._config()["z"] == drift.DEFAULT_Z
+    assert "HPNN_DRIFT_Z" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------- psi
+def test_psi_debiased_null_is_zero_and_shift_breaches():
+    ref = np.array([10, 10, 10, 10, 10, 10, 10, 10])
+    assert drift._psi(ref, ref) == 0.0  # null clamped by the debias
+    moved = np.array([0, 0, 0, 0, 0, 0, 40, 40])
+    assert drift._psi(ref, moved) > drift.PSI_BREACH
+
+
+# ------------------------------------------------------------- ingest
+def test_ingest_sketch_detects_covariate_shift(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path)
+    rng = np.random.RandomState(1)
+    drift.note_ingest(_rows(rng, 16))           # freezes the reference
+    drift.note_ingest(_rows(rng, 16))           # clean live window
+    clean = drift.health_doc()["ingest"]["psi"]
+    assert clean is not None and clean < drift.PSI_BREACH
+    drift.note_ingest(_rows(rng, 16, loc=5.0))  # shifted live window
+    drift.note_ingest(_rows(rng, 16, loc=5.0))  # still over: no re-fire
+    obs.flush()
+    recs = _read(sink)
+    scores = [r for r in recs if r.get("ev") == "drift.score"
+              and r.get("detector") == "ingest"]
+    assert scores and scores[0]["kernel"] == "stream"
+    assert scores[-1]["value"] >= 1.0
+    events = [r for r in recs if r.get("ev") == "online.drift"]
+    assert len(events) == 1                     # rising edge only
+    assert events[0]["detector"] == "ingest"
+    assert "ingest:stream" in drift.health_doc()["over"]
+
+
+def test_ingest_rearms_after_recovery(monkeypatch, tmp_path):
+    """Score falling back under the bound re-arms the edge: a second
+    shift emits a second online.drift event."""
+    sink = _arm(monkeypatch, tmp_path)
+    rng = np.random.RandomState(2)
+    drift.note_ingest(_rows(rng, 16))
+    drift.note_ingest(_rows(rng, 16, loc=5.0))   # first breach
+    drift.note_ingest(_rows(rng, 32))            # live ring all clean
+    drift.note_ingest(_rows(rng, 16, loc=5.0))   # second breach
+    obs.flush()
+    events = [r for r in _read(sink) if r.get("ev") == "online.drift"]
+    assert len(events) == 2
+
+
+def test_single_row_feeds_fold_on_the_stride(monkeypatch, tmp_path):
+    """Row-at-a-time taps stage until ``_STRIDE`` rows, so the PSI
+    recompute and gauge publish never run per request."""
+    sink = _arm(monkeypatch, tmp_path)
+    rng = np.random.RandomState(3)
+    for _ in range(2 * drift._STRIDE):   # reference (16) + live (16)
+        drift.note_ingest(_rows(rng, 1))
+    obs.flush()
+    scores = [r for r in _read(sink) if r.get("ev") == "drift.score"]
+    assert len(scores) == 1              # one fold scored, not 16
+    for _ in range(drift._STRIDE - 1):
+        drift.note_ingest(_rows(rng, 1))
+    obs.flush()
+    assert len([r for r in _read(sink)
+                if r.get("ev") == "drift.score"]) == 1  # still staged
+
+
+# --------------------------------------------------------------- pred
+def test_pred_sketch_detects_class_mix_shift(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path)
+    rng = np.random.RandomState(4)
+    ref = rng.uniform(-1, 0, size=(16, 4))
+    ref[:, 0] = 1.0                              # argmax class 0
+    drift.note_pred("k", ref)                    # freezes the reference
+    live = rng.uniform(-1, 0, size=(16, 4))
+    live[:, 2] = 1.0                             # argmax class 2
+    drift.note_pred("k", live)
+    obs.flush()
+    recs = _read(sink)
+    shifts = [r for r in recs if r.get("ev") == "drift.pred_shift"]
+    assert shifts and shifts[-1]["kernel"] == "k"
+    assert shifts[-1]["value"] > drift.PSI_BREACH
+    events = [r for r in recs if r.get("ev") == "online.drift"]
+    assert [e["detector"] for e in events] == ["pred"]
+
+
+def test_serve_dispatch_taps_the_pred_sketch(monkeypatch, tmp_path):
+    """The real serve path feeds the sketch: enough single infers and
+    the kernel's prediction gauges land in the sink."""
+    sink = _arm(monkeypatch, tmp_path)
+    kern, _ = kernel_mod.generate(7, 8, [5], 2)
+    sess = serve.Session(max_batch=8, n_buckets=1, max_wait_ms=0.5)
+    try:
+        sess.register_kernel("srv", kern)
+        rng = np.random.RandomState(5)
+        for _ in range(3 * drift._STRIDE):
+            sess.infer("srv", rng.normal(size=8))
+    finally:
+        sess.close()
+    obs.flush()
+    shifts = [r for r in _read(sink)
+              if r.get("ev") == "drift.pred_shift"]
+    assert shifts and shifts[-1]["kernel"] == "srv"
+
+
+# --------------------------------------------------------------- eval
+def test_eval_sentinel_warmup_then_decay(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path, z=1.5)
+    for _ in range(drift._WARMUP + 5):
+        drift.note_eval("k", 0.5)       # flat: the sentinel is quiet
+    obs.flush()
+    recs = _read(sink)
+    zs = [r for r in recs if r.get("ev") == "drift.eval_decay"]
+    assert len(zs) == drift._WARMUP + 5          # every eval gauged
+    assert all(r["value"] == 0.0 for r in zs[:drift._WARMUP])
+    assert not [r for r in recs if r.get("ev") == "online.drift"]
+    drift.note_eval("k", 5.0)                    # decay step
+    obs.flush()
+    recs = _read(sink)
+    z = [r for r in recs if r.get("ev") == "drift.eval_decay"][-1]
+    assert z["value"] > 1.5 and math.isfinite(z["value"])
+    events = [r for r in recs if r.get("ev") == "online.drift"]
+    assert [e["detector"] for e in events] == ["eval"]
+    assert events[0]["kernel"] == "k"
+    assert events[0]["score"] >= 1.0
+
+
+def test_trainer_round_feeds_the_sentinel(monkeypatch, tmp_path):
+    """A real online round emits ``online.eval_resident`` every round
+    and, armed, the sentinel's ``drift.eval_decay`` gauge."""
+    sink = _arm(monkeypatch, tmp_path)
+    sess = OnlineSession(rows=16, batch=4, epochs=2, holdout=4,
+                         seed=0, start=False,
+                         serve_kwargs=dict(max_batch=8, n_buckets=1,
+                                           max_wait_ms=0.5))
+    try:
+        kern, _ = kernel_mod.generate(1, 8, [5], 2)
+        sess.add_kernel("k", kern)
+        rng = np.random.RandomState(7)
+        X = rng.uniform(0.0, 1.0, (48, 8))
+        sess.feed(X, np.tanh(X[:, :2]))
+        sess.tick()
+    finally:
+        sess.close()
+    obs.flush()
+    recs = _read(sink)
+    resident = [r for r in recs
+                if r.get("ev") == "online.eval_resident"]
+    assert resident and resident[-1]["kernel"] == "k"
+    assert math.isfinite(resident[-1]["value"])
+    assert [r for r in recs if r.get("ev") == "drift.eval_decay"]
+
+
+# ------------------------------------------------------------ streams
+def test_label_shift_wrapper_remaps_targets_only():
+    def stream():
+        for i in range(8):
+            x = np.full(4, float(i))
+            t = np.full(3, -1.0)
+            t[i % 3] = 1.0
+            yield x, t
+
+    plain = list(stream())
+    shifted = list(streams.label_shift(stream(), 5, {0: 1, 1: 2, 2: 0}))
+    for i, ((xp, tp), (xs, ts)) in enumerate(zip(plain, shifted)):
+        assert np.array_equal(xp, xs)            # inputs untouched
+        if i < 5:
+            assert np.array_equal(tp, ts)
+        else:
+            assert int(np.argmax(ts)) == (int(np.argmax(tp)) + 1) % 3
+    again = list(streams.label_shift(stream(), 5, {0: 1, 1: 2, 2: 0}))
+    assert all(np.array_equal(a[1], b[1])
+               for a, b in zip(shifted, again))  # deterministic
+
+
+def test_rotate_wrapper_square_and_phase_roll():
+    def stream(n_in):
+        for i in range(4):
+            x = np.zeros(n_in)
+            x[i] = 1.0
+            yield x, np.array([1.0])
+
+    # 3x3 square: a 90-degree rotation moves the corner pixel
+    out = list(streams.rotate(stream(9), 2, 90.0))
+    for i, (x, t) in enumerate(out):
+        assert np.array_equal(t, np.array([1.0]))  # targets untouched
+        if i < 2:
+            assert x[i] == 1.0
+    assert not np.array_equal(out[2][0], np.eye(9)[2])
+    assert out[2][0].sum() == 1.0                # still one hot pixel
+    # non-square: angle/360 of the length as a circular shift
+    rolled = list(streams.rotate(stream(10), 0, 36.0))
+    assert np.argmax(rolled[0][0]) == 1          # 0 rolled by one slot
+
+
+# ------------------------------------------------- capsule + health
+def test_capture_capsule_carries_drift_json(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    monkeypatch.setenv("HPNN_CAPSULE_DIR", str(tmp_path / "caps"))
+    monkeypatch.setenv("HPNN_CAPSULE_PROFILE_MS", "0")
+    obs._reset_for_tests()
+    rng = np.random.RandomState(8)
+    drift.note_ingest(_rows(rng, 32))
+    man = triggers.capture("manual")
+    assert man is not None and "drift.json" in man["files"]
+    doc = json.load(open(os.path.join(man["capsule"], "drift.json")))
+    assert doc["ingest"]["reference"] and doc["ingest"]["live"]
+    assert doc["window"] == drift.WINDOW_FLOOR
+
+
+def test_capture_without_drift_has_no_artifact(monkeypatch, tmp_path):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.delenv("HPNN_DRIFT", raising=False)
+    monkeypatch.setenv("HPNN_CAPSULE_DIR", str(tmp_path / "caps"))
+    monkeypatch.setenv("HPNN_CAPSULE_PROFILE_MS", "0")
+    obs._reset_for_tests()
+    man = triggers.capture("manual")
+    assert man is not None and "drift.json" not in man["files"]
+
+
+def test_health_doc_census(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    rng = np.random.RandomState(9)
+    drift.note_ingest(_rows(rng, 32))
+    drift.note_pred("k", rng.normal(size=(32, 4)))
+    drift.note_eval("k", 0.5)
+    doc = drift.health_doc()
+    assert doc["armed"] and doc["window"] == drift.WINDOW_FLOOR
+    assert doc["ingest"]["frozen"] and doc["ingest"]["rows_seen"] == 32
+    assert "k" in doc["pred"] and "k" in doc["eval"]
+    assert doc["psi_breach"] == drift.PSI_BREACH

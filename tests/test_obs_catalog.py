@@ -430,3 +430,107 @@ def test_main_fleet_flag_exit_codes(tmp_path, capsys):
                         "n": 1}])
     assert mod.main(["--fleet", str(path)]) == 1
     capsys.readouterr()
+
+
+def _drift_rows():
+    return [
+        {"ev": "drift.score", "kind": "gauge", "value": 0.4,
+         "detector": "ingest", "kernel": "stream"},
+        {"ev": "drift.score", "kind": "gauge", "value": 1.6,
+         "detector": "eval", "kernel": "k"},
+        {"ev": "drift.pred_shift", "kind": "gauge", "value": 0.12,
+         "kernel": "k"},
+        {"ev": "drift.eval_decay", "kind": "gauge", "value": -0.8,
+         "kernel": "k"},
+        {"ev": "online.drift", "kind": "event", "detector": "eval",
+         "kernel": "k", "score": 1.6, "window": 64, "raw": 1.97},
+        {"ev": "online.eval_resident", "kind": "gauge", "value": 0.43,
+         "kernel": "k"},
+        {"ev": "alert.fire", "rule": "drift", "gauge": "drift.score",
+         "severity": "warn", "value": 1.6},
+    ]
+
+
+def test_drift_lint_accepts_a_well_formed_sink(tmp_path):
+    mod = _load()
+    path = tmp_path / "drift.jsonl"
+    _write_sink(path, _drift_rows())
+    assert mod.lint_drift(str(path)) == []
+
+
+def test_drift_lint_catches_every_schema_break(tmp_path):
+    """Each clause bites: non-gauge score, NaN score, unknown
+    detector, empty kernel, negative PSI, non-numeric z, a
+    below-bound online.drift, bad window, missing raw, and a NaN
+    resident eval."""
+    mod = _load()
+    path = tmp_path / "drift.jsonl"
+    breaks = [
+        ({"ev": "drift.score", "kind": "event", "value": 0.4,
+          "detector": "ingest", "kernel": "stream"}, "!= 'gauge'"),
+        ({"ev": "drift.score", "kind": "gauge", "value": float("nan"),
+          "detector": "ingest", "kernel": "stream"},
+         "finite non-negative"),
+        ({"ev": "drift.score", "kind": "gauge", "value": 0.4,
+          "detector": "vibes", "kernel": "stream"}, "detector"),
+        ({"ev": "drift.score", "kind": "gauge", "value": 0.4,
+          "detector": "ingest", "kernel": ""}, "kernel"),
+        ({"ev": "drift.pred_shift", "kind": "gauge", "value": -0.1,
+          "kernel": "k"}, "finite non-negative"),
+        ({"ev": "drift.eval_decay", "kind": "gauge", "value": "low",
+          "kernel": "k"}, "finite number"),
+        ({"ev": "online.drift", "kind": "event", "detector": "eval",
+          "kernel": "k", "score": 0.4, "window": 64, "raw": 0.5},
+         "breach edge"),
+        ({"ev": "online.drift", "kind": "event", "detector": "eval",
+          "kernel": "k", "score": 1.6, "window": 0, "raw": 0.5},
+         "int >= 1"),
+        ({"ev": "online.drift", "kind": "event", "detector": "eval",
+          "kernel": "k", "score": 1.6, "window": 64}, "raw"),
+        ({"ev": "online.eval_resident", "kind": "gauge", "value": None,
+          "kernel": "k"}, "finite number"),
+    ]
+    for rec, needle in breaks:
+        _write_sink(path, [rec])
+        failures = mod.lint_drift(str(path))
+        assert failures, f"schema break not caught: {rec}"
+        assert any(needle in f for f in failures), (needle, failures)
+
+
+def test_drift_lint_fails_an_unarmed_sink(tmp_path):
+    mod = _load()
+    path = tmp_path / "quiet.jsonl"
+    _write_sink(path, [{"ev": "obs.summary", "kind": "summary"}])
+    assert any("no drift records" in f
+               for f in mod.lint_drift(str(path)))
+
+
+def test_drift_lint_checks_the_capsule_artifact(tmp_path):
+    """A capsule captured for a drift-rule alert must contain
+    drift.json; writing the artifact clears the failure."""
+    mod = _load()
+    path = tmp_path / "drift.jsonl"
+    cap = tmp_path / "capsule-1-alert-drift"
+    cap.mkdir()
+    rows = _drift_rows() + [
+        {"ev": "forensics.capture_done", "kind": "event",
+         "reason": "alert:drift", "capsule": str(cap),
+         "files": ["spans.jsonl"]},
+    ]
+    _write_sink(path, rows)
+    assert any("drift.json" in f for f in mod.lint_drift(str(path)))
+    (cap / "drift.json").write_text("{}")
+    assert mod.lint_drift(str(path)) == []
+
+
+def test_main_drift_flag_exit_codes(tmp_path, capsys):
+    mod = _load()
+    path = tmp_path / "drift.jsonl"
+    _write_sink(path, _drift_rows())
+    assert mod.main(["--drift", str(path)]) == 0
+    _write_sink(path, [{"ev": "drift.score", "kind": "gauge",
+                        "value": -2.0, "detector": "ingest",
+                        "kernel": "stream"}])
+    assert mod.main(["--drift", str(path)]) == 1
+    assert mod.main(["--drift"]) == 2
+    capsys.readouterr()

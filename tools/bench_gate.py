@@ -132,6 +132,15 @@ GATE_METRICS = {
     "sampler_overhead_pct": ("lower", 2.00),
     "drill_capsule_capture_s": ("lower", 1.50),
     "drill_capsule_blame_pct": ("higher", 0.30),
+    # drift fold-ins (bench.py bench_drift_overhead +
+    # tools/chaos_drill.py run_bench_drift_drill;
+    # docs/observability.md "Drift detection"): the paired marginal
+    # cost of armed sketches on the serve + ingest hot paths
+    # (acceptance bar <=5% — medians hover near zero, so the
+    # tolerance is wide like the other overhead gates), and time
+    # from the label shift to the drift alert firing in the drill
+    "drift_overhead_pct": ("lower", 2.00),
+    "drill_drift_detect_s": ("lower", 1.50),
     # cross-host fleet fold-ins (tools/chaos_drill.py
     # run_bench_worker_drill + tools/bench_autoscale.py;
     # docs/serving.md "Cross-host fleet"): the worker-process kill

@@ -60,18 +60,32 @@ catalog (docs/resilience.md):
   ``tools/tail_report.py`` over the run's sink blames the dispatch
   phase — the injected seam — for most of the tail.
 
+* **drift** — the drift observability plane's live proof
+  (docs/observability.md "Drift detection"): an in-process
+  train-while-serve session learns the clean synthetic-MNIST
+  stream, ``HPNN_DRIFT`` is armed (references freeze, the decay
+  sentinel warms up), then the stream's labels are remapped
+  (``streams.label_shift``).  Asserts the held-out decay drives
+  ``drift.score`` over a threshold rule → ``alert.fire`` → a
+  capsule whose ``drift.json`` carries both the reference and the
+  post-shift sketches, with serving answering throughout (zero
+  lost) and detection latency bounded.
+
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
 ``drill.reload`` | ``drill.sentinel`` | ``drill.replica`` |
-``drill.alert`` | ``drill.worker`` | ``drill.capsule``;
+``drill.alert`` | ``drill.worker`` | ``drill.capsule`` |
+``drill.drift``;
 :func:`run_bench_drill` /
 :func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` /
-:func:`run_bench_worker_drill` / :func:`run_bench_capsule_drill` are
+:func:`run_bench_worker_drill` / :func:`run_bench_capsule_drill` /
+:func:`run_bench_drift_drill` are
 the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
 ``drill_replica_dip_pct`` / ``drill_replica_survivors_lost`` /
 ``drill_alert_fire_s`` / ``drill_alert_resolved`` /
 ``drill_worker_dip_pct`` / ``drill_worker_replaced_s`` /
-``drill_capsule_capture_s`` / ``drill_capsule_blame_pct``, gated by
+``drill_capsule_capture_s`` / ``drill_capsule_blame_pct`` /
+``drill_drift_detect_s``, gated by
 ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
@@ -308,7 +322,9 @@ class _Load:
 
     def __init__(self, port: int, *, rate: float = 40.0,
                  duration_s: float = 240.0, ingest_frac: float = 0.5,
-                 retries: int = 3, seed: int = 0):
+                 retries: int = 3, seed: int = 0,
+                 kernels: tuple = (KERNEL,), n_in: int = 8,
+                 n_out: int = 2):
         import loadgen
 
         self.records: list[dict] = []
@@ -320,10 +336,10 @@ class _Load:
             self.summary = loadgen.run_open_loop(
                 f"http://127.0.0.1:{port}", rate_rps=rate,
                 duration_s=duration_s, process="poisson",
-                kernels=(KERNEL,), rows_choices=(1, 2),
-                n_in=8, timeout_s=2.0, max_retries=retries,
+                kernels=tuple(kernels), rows_choices=(1, 2),
+                n_in=n_in, timeout_s=2.0, max_retries=retries,
                 retry_cap_s=0.25, n_workers=8, seed=seed,
-                ingest_frac=ingest_frac, n_out=2, stop=self.stop,
+                ingest_frac=ingest_frac, n_out=n_out, stop=self.stop,
                 on_record=self.records.append)
 
         self.thread = threading.Thread(target=run, daemon=True)
@@ -924,6 +940,164 @@ def drill_capsule(workdir: str, *, rate: float = 12.0,
         chaos_mod._reset_for_tests()
 
 
+def drill_drift(workdir: str, *, rate: float = 20.0,
+                seed: int = 7) -> dict:
+    """The drift plane's live proof (docs/observability.md "Drift
+    detection"): an in-process train-while-serve session on the
+    synthetic-MNIST stream, loadgen inference traffic flowing, a
+    ``drift.score`` threshold rule and a capsule dir armed.  The
+    session first *learns* the clean stream (a label shift is only
+    visible to a model that learned the mapping), then ``HPNN_DRIFT``
+    is armed so the sketch references freeze on the converged
+    steady-state and the decay sentinel warms up, then the stream's
+    labels are remapped (``streams.label_shift``).  The resident's
+    held-out loss ramps, the sentinel z breaches ``HPNN_DRIFT_Z``,
+    the normalized score crosses the rule → ``alert.fire`` → a
+    capture capsule whose ``drift.json`` holds both the reference
+    and the post-shift sketches — while serving answers throughout
+    (zero lost).  Detection latency is the gateable
+    ``drill_drift_detect_s``."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.online import streams
+    from hpnn_tpu.online.session import OnlineSession
+    from hpnn_tpu.serve import make_server
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.drift", "ok": False}
+    sink = os.path.join(workdir, "drift-drill.metrics.jsonl")
+    capsule_dir = os.path.join(workdir, "capsules")
+    env_keys = ("HPNN_DRIFT", "HPNN_DRIFT_WINDOW", "HPNN_DRIFT_Z",
+                "HPNN_ALERTS", "HPNN_CAPSULE_DIR",
+                "HPNN_CAPSULE_PROFILE_MS", "HPNN_CAPSULE_COOLDOWN_S",
+                "HPNN_METRICS")
+    prev_env = {key: os.environ.get(key) for key in env_keys}
+    os.environ.pop("HPNN_DRIFT", None)  # armed mid-drill, below
+    os.environ["HPNN_ALERTS"] = ("drift@drift.score>1:"
+                                 "for=0,cooldown=0,severity=warn")
+    os.environ["HPNN_CAPSULE_DIR"] = capsule_dir
+    os.environ["HPNN_CAPSULE_PROFILE_MS"] = "0"
+    os.environ["HPNN_CAPSULE_COOLDOWN_S"] = "0"
+    # Phase lengths, in trainer rounds of FEEDS stream samples each:
+    # CONVERGE clean rounds to learn the mapping, WARMUP armed clean
+    # rounds (sketch references freeze, sentinel EWMA seeds), then
+    # shifted rounds until the alert fires.  The sentinel z asymptote
+    # against a ramp is ~2 (obs/drift.py), so the drill arms
+    # HPNN_DRIFT_Z below that.
+    converge, warmup, max_shifted, feeds = 25, 12, 15, 80
+    stream = streams.label_shift(
+        streams.mnist_stream(7), (converge + warmup) * feeds,
+        {i: (i + 1) % 10 for i in range(10)})
+    session = server = None
+
+    def _manifest():
+        for dirpath, _dirs, files in os.walk(capsule_dir):
+            if "manifest.json" in files:
+                return os.path.join(dirpath, "manifest.json")
+        return None
+
+    def _round():
+        for _ in range(feeds):
+            x, t = next(stream)
+            session.feed(x, t)
+        session.tick()
+
+    try:
+        obs.configure(sink)  # alert rule + capsule trigger armed
+        session = OnlineSession(rows=64, batch=8, epochs=16,
+                                holdout=4, seed=0, start=False)
+        kern, _ = kernel_mod.generate(1, streams.MNIST_N_IN, [32],
+                                      streams.MNIST_N_OUT)
+        session.add_kernel("mnist", kern)
+        server = make_server(session.serve)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        load = _Load(port, rate=rate, ingest_frac=0.0, seed=seed,
+                     kernels=("mnist",), n_in=streams.MNIST_N_IN,
+                     n_out=streams.MNIST_N_OUT)
+        for _ in range(converge):     # learn the clean mapping
+            _round()
+        obs.drift.configure("1", window=64, z=1.2)
+        for _ in range(warmup):       # freeze references, seed EWMA
+            _round()
+        # poll fired_total, not "active": drift.score is a
+        # multi-series gauge (one emission per detector), so the
+        # name-keyed threshold rule resolves the instant a calm
+        # detector's low score lands after the breaching one
+        if obs.alerts.health_doc().get("fired_total", 0) > 0:
+            load.finish()
+            out["error"] = "alert fired before the shift"
+            return out
+        t_shift = load.now()
+        rounds = None
+        for i in range(max_shifted):  # labels now lie
+            _round()
+            if obs.alerts.health_doc().get("fired_total", 0) > 0:
+                rounds = i + 1
+                break
+        t_fire = load.now()
+        manifest_path = (_wait(_manifest, 10.0, interval_s=0.05)
+                         if rounds is not None else None)
+        records = load.finish(settle_s=0.2)
+        health = obs.drift.health_doc()
+        obs.configure(None)   # close the sink for the audit below
+        events = []
+        with open(sink) as fp:
+            for line in fp:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        fires = [r for r in events if r.get("ev") == "alert.fire"
+                 and r.get("rule") == "drift"]
+        drifts = [r for r in events if r.get("ev") == "online.drift"]
+        man, sketches = {}, None
+        if manifest_path:
+            with open(manifest_path) as fp:
+                man = json.load(fp)
+            dj = os.path.join(os.path.dirname(manifest_path),
+                              "drift.json")
+            if os.path.exists(dj):
+                with open(dj) as fp:
+                    sketches = json.load(fp)
+        ingest = (sketches or {}).get("ingest") or {}
+        out["detect_s"] = (round(t_fire - t_shift, 3)
+                           if rounds is not None else None)
+        out["rounds_to_detect"] = rounds
+        out["requests"] = len(records)
+        out["lost"] = sum(1 for r in records if r["status"] == "lost")
+        out["capsule"] = man.get("capsule")
+        out["capsule_reason"] = man.get("reason")
+        out["drift_events"] = sorted(
+            {f"{r.get('detector')}:{r.get('kernel')}" for r in drifts})
+        out["eval_z"] = (health.get("eval", {}).get("mnist", {})
+                         .get("z"))
+        out["sketches"] = {"reference": bool(ingest.get("reference")),
+                           "live": bool(ingest.get("live"))}
+        out["ok"] = bool(rounds is not None and fires and drifts
+                         and manifest_path
+                         and str(man.get("reason", "")
+                                 ).startswith("alert:drift")
+                         and ingest.get("reference")
+                         and ingest.get("live")
+                         and out["requests"] > 0
+                         and out["lost"] == 0)
+        return out
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if session is not None:
+            session.close()
+        obs.configure(None)
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
@@ -932,6 +1106,7 @@ DRILLS = {
     "alert": drill_alert,
     "worker": drill_worker,
     "capsule": drill_capsule,
+    "drift": drill_drift,
 }
 
 
@@ -1041,6 +1216,26 @@ def run_bench_worker_drill(*, rate: float = 60.0,
     return out
 
 
+def run_bench_drift_drill(*, rate: float = 20.0) -> dict:
+    """The bench.py fold-in for the drift drill: a label-shifted
+    stream under live traffic, detection latency as the gateable
+    ``drill_drift_detect_s``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_drift(tmp, rate=rate)
+    out = {
+        "metric": "drift_drill",
+        "drill": row,
+        "detect_s": row.get("detect_s"),
+        "rounds_to_detect": row.get("rounds_to_detect"),
+        "lost": row.get("lost"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 def run_bench_capsule_drill(*, rate: float = 60.0) -> dict:
     """The bench.py fold-in for the capsule drill: sampler + delayed
     dispatch seam + firing p99 rule under load, reported as gateable
@@ -1070,10 +1265,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
                     "(kill9 / reload / sentinel / replica / alert / "
-                    "worker / capsule)")
+                    "worker / capsule / drift)")
     ap.add_argument("--drill", default="all",
                     choices=("all", "kill9", "reload", "sentinel",
-                             "replica", "alert", "worker", "capsule"))
+                             "replica", "alert", "worker", "capsule",
+                             "drift"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

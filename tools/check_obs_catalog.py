@@ -108,12 +108,23 @@ value severs that link, so their shapes (and the per-process
 capture/capture_done pairing) are frozen too (docs/observability.md
 "Forensics").
 
+And the drift-detection schema lint (:func:`lint_drift`): the
+``drift.score`` / ``drift.pred_shift`` / ``drift.eval_decay`` gauges
+and ``online.drift`` breach events (obs/drift.py, HPNN_DRIFT), plus
+the ``online.eval_resident`` sentinel food (online/trainer.py), are
+how an operator proves a stream moved — a NaN score can never cross
+an alert rule, a breach event that can't say which detector or
+kernel is unactionable, and a drift-alert capsule without its
+``drift.json`` sketch dump severs the alert→evidence link — so
+their shapes are frozen too (docs/observability.md "Drift
+detection").
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
         [--serve-replicas PATH] [--fleet PATH] [--cluster PATH]
-        [--forensics PATH]
+        [--forensics PATH] [--drift PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -856,7 +867,8 @@ def lint_quant(path: str) -> list[str]:
 CHAOS_ACTIONS = ("kill", "raise", "delay", "nan")
 WAL_SKIP_REASONS = ("sig", "torn", "magic")
 DRILL_EVS = ("drill.kill9", "drill.reload", "drill.sentinel",
-             "drill.replica", "drill.worker", "drill.capsule")
+             "drill.replica", "drill.alert", "drill.worker",
+             "drill.capsule", "drill.drift")
 
 
 def lint_chaos(path: str) -> list[str]:
@@ -1052,6 +1064,21 @@ def lint_chaos(path: str) -> list[str]:
                     failures.append(
                         f"{at}: passing drill.worker replaced_s "
                         f"{rp!r} is not a non-negative number")
+            if ev == "drill.drift" and ok:
+                # a passing drift drill must say how long detection
+                # took and that the capsule carried the sketches
+                ds = rec.get("detect_s")
+                if not _num(ds) or not math.isfinite(ds) or ds < 0:
+                    failures.append(
+                        f"{at}: passing drill.drift detect_s {ds!r} "
+                        "is not a non-negative number")
+                sk = rec.get("sketches")
+                if not (isinstance(sk, dict)
+                        and sk.get("reference") and sk.get("live")):
+                    failures.append(
+                        f"{at}: passing drill.drift sketches {sk!r} "
+                        "do not show both reference and live — the "
+                        "capsule's drift.json was never proven")
     if not n_seen:
         failures.append(
             f"{path!r} has no chaos.* / wal.* / drill.* / "
@@ -1711,6 +1738,165 @@ def lint_forensics(path: str) -> list[str]:
     return failures
 
 
+# the drift-detection record contracts (obs/drift.py,
+# online/trainer.py; docs/observability.md "Drift detection")
+DRIFT_DETECTORS = ("ingest", "pred", "eval")
+
+
+def lint_drift(path: str) -> list[str]:
+    """Schema-lint the drift-detection records of one metrics sink (a
+    run with ``HPNN_DRIFT`` armed — docs/observability.md "Drift
+    detection").
+
+    Checks, per record:
+
+    * ``drift.score`` gauges — ``kind == "gauge"``; a finite
+      non-negative ``value`` (the normalized score; a NaN score can
+      never cross an alert rule, so drift would rot invisibly);
+      ``detector`` one of ingest/pred/eval; a non-empty ``kernel``.
+    * ``drift.pred_shift`` gauges — finite non-negative ``value``
+      (a PSI), non-empty ``kernel``.
+    * ``drift.eval_decay`` gauges — finite ``value`` (the *signed*
+      sentinel z), non-empty ``kernel``.
+    * ``online.drift`` events — ``detector`` one of
+      ingest/pred/eval; non-empty ``kernel``; finite ``score`` >= 1
+      (the event is the rising edge of the breach bound); ``window``
+      an int >= 1; finite ``raw`` statistic.
+    * ``online.eval_resident`` gauges — finite ``value``, non-empty
+      ``kernel`` (the sentinel's food; a NaN resident eval starves
+      it).
+    * capsule linkage — for every ``forensics.capture_done`` whose
+      ``reason`` is ``alert:<rule>`` where some ``alert.fire`` shows
+      that rule watching a ``drift.*`` gauge, the capsule directory
+      must contain ``drift.json`` (checked only when the directory
+      still exists — drill temp dirs may be gone).
+
+    A sink with no drift records fails — this lint only makes sense
+    on a drift-armed run.  Returns failure strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_drift = 0
+    drift_rules: set = set()
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if (isinstance(rec, dict) and rec.get("ev") == "alert.fire"
+                and str(rec.get("gauge", "")).startswith("drift.")):
+            drift_rules.add(rec.get("rule"))
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev == "drift.score":
+            n_drift += 1
+            if rec.get("kind") != "gauge":
+                failures.append(
+                    f"{at}: drift.score kind {rec.get('kind')!r} "
+                    "!= 'gauge'")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: drift.score value {v!r} is not a finite "
+                    "non-negative number")
+            if rec.get("detector") not in DRIFT_DETECTORS:
+                failures.append(
+                    f"{at}: drift.score detector "
+                    f"{rec.get('detector')!r} not in "
+                    f"{'/'.join(DRIFT_DETECTORS)}")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: drift.score kernel {k!r} is not a "
+                    "non-empty string")
+        elif ev in ("drift.pred_shift", "drift.eval_decay"):
+            n_drift += 1
+            v = rec.get("value")
+            bad = (not _num(v) or not math.isfinite(v)
+                   or (ev == "drift.pred_shift" and v < 0))
+            if bad:
+                want = ("finite non-negative number"
+                        if ev == "drift.pred_shift"
+                        else "finite number")
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a {want}")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: {ev} kernel {k!r} is not a non-empty "
+                    "string")
+        elif ev == "online.drift":
+            n_drift += 1
+            if rec.get("detector") not in DRIFT_DETECTORS:
+                failures.append(
+                    f"{at}: online.drift detector "
+                    f"{rec.get('detector')!r} not in "
+                    f"{'/'.join(DRIFT_DETECTORS)}")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: online.drift kernel {k!r} is not a "
+                    "non-empty string")
+            s = rec.get("score")
+            if not _num(s) or not math.isfinite(s) or s < 1.0:
+                failures.append(
+                    f"{at}: online.drift score {s!r} is not a finite "
+                    "number >= 1 (the event is the breach edge)")
+            w = rec.get("window")
+            if not _pos_int(w):
+                failures.append(
+                    f"{at}: online.drift window {w!r} is not an "
+                    "int >= 1")
+            raw = rec.get("raw")
+            if not _num(raw) or not math.isfinite(raw):
+                failures.append(
+                    f"{at}: online.drift raw {raw!r} is not a finite "
+                    "number")
+        elif ev == "online.eval_resident":
+            n_drift += 1
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v):
+                failures.append(
+                    f"{at}: online.eval_resident value {v!r} is not "
+                    "a finite number")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: online.eval_resident kernel {k!r} is not "
+                    "a non-empty string")
+        elif ev == "forensics.capture_done" and drift_rules:
+            reason = str(rec.get("reason", ""))
+            rule = (reason[len("alert:"):]
+                    if reason.startswith("alert:") else None)
+            cap = rec.get("capsule")
+            if (rule in drift_rules and isinstance(cap, str)
+                    and os.path.isdir(cap)
+                    and not os.path.exists(
+                        os.path.join(cap, "drift.json"))):
+                failures.append(
+                    f"{at}: capsule {cap!r} captured for drift alert "
+                    f"{rule!r} has no drift.json — the sketch dump "
+                    "the capture exists to preserve")
+    if not n_drift:
+        failures.append(
+            f"sink {path!r} has no drift records — was HPNN_DRIFT "
+            "armed during this run?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1782,6 +1968,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_forensics(argv[i + 1])
+    if "--drift" in argv:
+        i = argv.index("--drift")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --drift needs a "
+                             "path\n")
+            return 2
+        failures += lint_drift(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -40,7 +40,10 @@ rule that actually fires on the round's own ``fuse.chunk_size``
 gauge — docs/observability.md "Fleet telemetry"), the tail-latency
 forensics plane (``HPNN_SAMPLE`` at rate 1 plus ``HPNN_CAPSULE_DIR``
 — the firing alert must pull the capture trigger and land a capsule
-manifest, while stdout stays frozen), and a
+manifest, while stdout stays frozen), the drift-detection plane
+(``HPNN_DRIFT`` — its taps live in online ingest, serve dispatch, and
+the online trainer's holdout evals, none on the train path, so armed
+sketches must stay inert here), and a
 live export server whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
@@ -230,6 +233,7 @@ def check(tmpdir: str) -> list[str]:
     # no request spans, so it must stay inert) plus a capsule dir the
     # firing alert rule above must actually capture into — async, with
     # the profiler window off so the capsule is just files
+    from hpnn_tpu.obs import drift as drift_mod
     from hpnn_tpu.obs import forensics as forensics_mod
     from hpnn_tpu.obs import triggers as triggers_mod
 
@@ -238,12 +242,18 @@ def check(tmpdir: str) -> list[str]:
     os.environ["HPNN_CAPSULE_DIR"] = capsule_dir
     os.environ["HPNN_CAPSULE_PROFILE_MS"] = "0"
     os.environ["HPNN_CAPSULE_COOLDOWN_S"] = "0"
+    # drift detection (docs/observability.md "Drift detection") rides
+    # the same proof: the sketches tap online ingest / serve dispatch /
+    # the online trainer's holdout evals, none of which a plain train
+    # round touches — armed, it must stay inert on stdout and the sink
+    os.environ["HPNN_DRIFT"] = "1"
     for knob, val in _ONLINE_KNOBS:
         os.environ[knob] = val
     chaos_mod._reset_for_tests()
     wal_mod._reset_for_tests()
     forensics_mod._reset_for_tests()
     triggers_mod._reset_for_tests()
+    drift_mod._reset_for_tests()
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
@@ -255,13 +265,14 @@ def check(tmpdir: str) -> list[str]:
                      "HPNN_COLLECTOR_FLUSH_S", "HPNN_ALERTS",
                      "HPNN_SAMPLE", "HPNN_CAPSULE_DIR",
                      "HPNN_CAPSULE_PROFILE_MS",
-                     "HPNN_CAPSULE_COOLDOWN_S") \
+                     "HPNN_CAPSULE_COOLDOWN_S", "HPNN_DRIFT") \
                 + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
         chaos_mod._reset_for_tests()
         wal_mod._reset_for_tests()
         forensics_mod._reset_for_tests()
         triggers_mod._reset_for_tests()
+        drift_mod._reset_for_tests()
 
     if plain != instrumented:
         failures.append(
@@ -270,7 +281,8 @@ def check(tmpdir: str) -> list[str]:
             "HPNN_SPANS + HPNN_COST + HPNN_SLO_MS + HPNN_CHAOS + "
             "HPNN_WAL_DIR + HPNN_COLLECTOR (live push) + HPNN_ALERTS "
             "(firing rule) + HPNN_SAMPLE + HPNN_CAPSULE_DIR "
-            "(alert-triggered capture) + HPNN_ONLINE_* (incl. "
+            "(alert-triggered capture) + HPNN_DRIFT (armed "
+            "sketches) + HPNN_ONLINE_* (incl. "
             "HPNN_ONLINE_SCAN_K) + "
             "HPNN_SERVE_DTYPE=bf16 + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")
