@@ -1448,6 +1448,40 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["autoscale_error"] = repr(exc)
 
+    # Multi-tenant hosting (tools/loadgen.py run_bench_tenant): one
+    # TenantSession hosting 10k kernels across 8 tenants under a
+    # 256-kernel LRU paging cap, driven by Zipf traffic — registration
+    # throughput at scale, bounded RSS growth, measured cold-hit
+    # paging p99, goodput, and the quota-shed census of the hottest
+    # tenant (docs/tenancy.md).  HPNN_BENCH_NO_TENANT=1 skips it
+    # (in-process, ~15 s).
+    if not os.environ.get("HPNN_BENCH_NO_TENANT"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import loadgen
+
+            out["tenant"] = loadgen.run_bench_tenant()
+        except Exception as exc:
+            out["tenant_error"] = repr(exc)
+
+    # Quota drill (tools/chaos_drill.py run_bench_quota_drill): a
+    # hostile tenant offers 10x its admission budget against a shared
+    # TenantSession while well-behaved tenants keep their traffic —
+    # prove the victims' goodput and p99 hold, every refusal is a
+    # clean `shed reason=quota` on the offender, and the per-tenant
+    # shed-rate alert fires (docs/tenancy.md).  Rides the same
+    # HPNN_BENCH_NO_DRILL knob (in-process, a few seconds).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["quota_drill"] = chaos_drill.run_bench_quota_drill()
+        except Exception as exc:
+            out["quota_drill_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -1571,6 +1605,22 @@ def main(argv=None) -> None:
         compact["drill_drift_detect_s"] = dd["detect_s"]
         compact["drill_drift_rounds"] = dd["rounds_to_detect"]
         compact["drill_drift_lost"] = dd["lost"]
+    if "tenant" in out:
+        tn = out["tenant"]
+        compact["tenant_register_krps"] = tn["register_krps"]
+        compact["tenant_rss_growth_mb"] = tn["rss_growth_mb"]
+        compact["tenant_cold_p99_ms"] = tn["cold_p99_ms"]
+        compact["tenant_goodput_rps"] = tn["goodput_rps"]
+        compact["tenant_resident_cap_ok"] = tn["resident_cap_ok"]
+        compact["tenant_quota_shed"] = tn["quota_shed"]
+    if ("quota_drill" in out
+            and out["quota_drill"].get("victim_p99_ms") is not None):
+        qd = out["quota_drill"]
+        compact["drill_quota_victim_p99_ms"] = qd["victim_p99_ms"]
+        compact["drill_quota_victim_goodput_ratio"] = (
+            qd["victim_goodput_ratio"])
+        compact["drill_quota_offender_shed"] = qd["offender_shed"]
+        compact["drill_quota_alert_fired"] = qd["alert_fired"]
     if ("autoscale" in out
             and out["autoscale"].get("goodput_x") is not None):
         asc = out["autoscale"]

@@ -441,6 +441,22 @@ KNOBS = {
     "HPNN_COMPILE_CACHE_DIR": {
         "default": None, "doc": "docs/serving.md",
         "desc": "persistent compiled-executable cache directory"},
+    "HPNN_COMPILE_CACHE_MAX_MB": {
+        "default": None, "doc": "docs/tenancy.md",
+        "desc": "compile-cache GC size cap in MiB (0/unset = no GC)"},
+    # --- multi-tenant hosting (docs/tenancy.md) ---
+    "HPNN_TENANT_SHARDS": {
+        "default": 16, "doc": "docs/tenancy.md",
+        "desc": "lock-striped registry shard count"},
+    "HPNN_TENANT_RESIDENT": {
+        "default": 0, "doc": "docs/tenancy.md",
+        "desc": "resident-kernel cap before LRU paging (0 = unbounded)"},
+    "HPNN_TENANT_PAGE_DIR": {
+        "default": None, "doc": "docs/tenancy.md",
+        "desc": "cold-kernel page store directory (objects/ + index/)"},
+    "HPNN_TENANTS": {
+        "default": None, "doc": "docs/tenancy.md",
+        "desc": "tenant quotas: t=class[:rate=R][:inflight=N][:burst=S],..."},
     # --- cross-host fleet autoscaler (docs/serving.md) ---
     "HPNN_FLEET_MIN": {
         "default": 1, "doc": "docs/serving.md",
@@ -587,5 +603,9 @@ KNOBS = {
     "HPNN_BENCH_NO_AUTOSCALE": {
         "default": None, "doc": "docs/analysis.md",
         "desc": "skip the autoscaler bench section",
+        "reader": "bench.py"},
+    "HPNN_BENCH_NO_TENANT": {
+        "default": None, "doc": "docs/tenancy.md",
+        "desc": "skip the multi-tenant hosting bench section",
         "reader": "bench.py"},
 }

@@ -219,6 +219,17 @@ class ClusterRouter:
                     obs.forensics.finish(rspan, rank=h.rank)
                     return out
                 except Shed as exc:
+                    if exc.reason == "quota":
+                        # a quota shed is the TENANT's budget, not
+                        # this worker's capacity: every worker would
+                        # answer the same, and routing around would
+                        # let one tenant launder its quota across the
+                        # fleet (docs/tenancy.md).  Propagate, and do
+                        # not cool the (healthy) worker.
+                        with self._stat_lock:
+                            self._shed += 1
+                        obs.slo.record("shed")
+                        raise
                     self._cool_down(h.rank, exc.retry_after_s)
                     obs.count("cluster.shed_around", rank=h.rank,
                               kernel=name, reason=exc.reason)

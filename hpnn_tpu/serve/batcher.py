@@ -60,7 +60,9 @@ class Shed(QueueFull):
     """Request rejected by SLO-driven admission control before enqueue
     — retriable after ``retry_after_s`` (the HTTP layer turns it into
     the 429 ``Retry-After`` header).  ``reason`` says which threshold
-    tripped (``queue_age`` | ``slo_p99``)."""
+    tripped (``queue_age`` | ``slo_p99`` here; the edge and tenant
+    layers reuse the class with ``rate_cap`` / ``quota`` /
+    ``no_worker`` / ``unready``)."""
 
     def __init__(self, msg: str, *, reason: str,
                  retry_after_s: float = 1.0):

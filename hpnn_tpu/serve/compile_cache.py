@@ -30,6 +30,7 @@ import threading
 from hpnn_tpu import obs
 
 ENV_DIR = "HPNN_COMPILE_CACHE_DIR"
+ENV_MAX_MB = "HPNN_COMPILE_CACHE_MAX_MB"
 
 _lock = threading.Lock()
 _armed = False
@@ -145,6 +146,59 @@ def stats() -> dict | None:
         except OSError:
             pass
     return doc
+
+
+def gc(max_mb: float | None = None) -> tuple[int, int]:
+    """Size-cap the cache directory: oldest-mtime entries go first
+    until the total is under ``max_mb`` (default from
+    ``HPNN_COMPILE_CACHE_MAX_MB``; unset/0 = no sweep).  Returns
+    ``(files, bytes)`` removed.
+
+    This is the version-churn eviction: cache keys hash the whole
+    lowered program, so a hot-reloaded kernel's old-version
+    executables are simply never looked up again — from the outside
+    they are indistinguishable from live entries, and an mtime LRU is
+    the honest policy (a warm entry's mtime refreshes when jax
+    rewrites it on a hit; cold churn sinks to the bottom).  Called by
+    the tenant pager after page-outs and available to cron
+    housekeeping (docs/tenancy.md)."""
+    if max_mb is None:
+        raw = os.environ.get(ENV_MAX_MB, "").strip()
+        if not raw:
+            return (0, 0)
+        max_mb = float(raw)  # junk raises: a silently ignored cap lies
+    if max_mb <= 0:
+        return (0, 0)
+    d = configured_dir() or _dir
+    if not d or not os.path.isdir(d):
+        return (0, 0)
+    entries = []
+    total = 0
+    try:
+        with os.scandir(d) as it:
+            for e in it:
+                if not e.is_file():
+                    continue
+                st = e.stat()
+                entries.append((st.st_mtime, st.st_size, e.path))
+                total += st.st_size
+    except OSError:
+        return (0, 0)
+    cap = int(max_mb * 1024 * 1024)
+    removed = freed = 0
+    for mtime, size, path in sorted(entries):
+        if total - freed <= cap:
+            break
+        try:
+            os.unlink(path)
+        except OSError:
+            continue  # racing process took it first
+        removed += 1
+        freed += size
+    if removed:
+        obs.event("serve.compile_cache_gc", entries=removed,
+                  bytes=freed, cap_mb=max_mb)
+    return (removed, freed)
 
 
 def _reset_for_tests() -> None:

@@ -423,13 +423,20 @@ class Engine:
                   kernel=entry.name, precision=prec, bucket=bucket)
         return err
 
-    def precision_doc(self) -> dict:
+    def precision_doc(self, names=None) -> dict:
         """The /healthz ``precision`` section: the process default,
         engine mode, and per-kernel resolved policy + measured
-        ``quant_err`` (present once warmup probed the kernel)."""
+        ``quant_err`` (present once warmup probed the kernel).
+        ``names`` restricts the per-kernel scan — the summarized
+        health path of a 10k-kernel host passes a sample instead of
+        enumerating the namespace (docs/tenancy.md)."""
         kernels = {}
-        for name in self.registry.names():
-            entry = self.registry.get(name)
+        for name in (self.registry.names() if names is None
+                     else names):
+            try:
+                entry = self.registry.get(name)
+            except KeyError:
+                continue  # paged out between sample and scan
             prec = self._precision(entry)
             doc = {"precision": prec or "native",
                    "version": entry.version}
@@ -766,10 +773,24 @@ class Engine:
 
     def evict(self, name: str, *, keep_version: int | None = None):
         """Drop cached executables/weights for ``name`` (all versions,
-        or all but ``keep_version``).  Reload housekeeping."""
+        or all but ``keep_version``).  Reload housekeeping — and the
+        pager's page-out hook (hpnn_tpu/tenant/pager.py), so fleet
+        executables whose member set includes ``name`` are dropped
+        too: a stacked program holds every member's weights, and a
+        paged-out kernel leaving its weights pinned inside a live
+        fleet executable would defeat the resident-set cap."""
+        def _fleet_member(k: tuple) -> bool:
+            head = k[0]
+            if not (isinstance(head, tuple) and head
+                    and head[0] == "fleet"):
+                return False
+            return any(m == name and v != keep_version
+                       for m, v in head[1:])
+
         with self._lock:
             for key in [k for k in self._compiled
-                        if k[0] == name and k[1] != keep_version]:
+                        if (k[0] == name and k[1] != keep_version)
+                        or _fleet_member(k)]:
                 del self._compiled[key]
             for key in [k for k in self._weights_cache
                         if k[0] == name and k[1] != keep_version]:

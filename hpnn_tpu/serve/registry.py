@@ -87,8 +87,12 @@ class Registry:
     whose layer chain is inconsistent.
     """
 
-    def __init__(self):
-        self._lock = obs.lockwatch.lock("serve.registry")
+    def __init__(self, *, lock_name: str = "serve.registry"):
+        # ``lock_name`` gives each lock-striped shard of a
+        # tenant.ShardedRegistry its own watched identity
+        # (``serve.registry.s<i>``) so the lockwatch order graph can
+        # tell the stripes apart (docs/tenancy.md).
+        self._lock = obs.lockwatch.lock(lock_name)
         self._entries: dict[str, Entry] = {}  # guarded: _lock
 
     # ------------------------------------------------------------ install
@@ -193,6 +197,30 @@ class Registry:
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._entries)
+
+    def count(self) -> int:
+        """O(1) kernel count — the list/health paths must not pay an
+        ``names()`` sort-and-copy just to know how many entries exist
+        (a 10k-kernel host asks this on every /healthz scrape)."""
+        with self._lock:
+            return len(self._entries)
+
+    def sample(self, k: int = 16) -> list[str]:
+        """Up to ``k`` kernel names, cheaply — dict order, no full
+        sort.  The summarized health document shows these instead of
+        enumerating thousands of entries (docs/tenancy.md)."""
+        out: list[str] = []
+        with self._lock:
+            for name in self._entries:
+                out.append(name)
+                if len(out) >= max(0, int(k)):
+                    break
+        return out
+
+    def census(self) -> dict:
+        """Summary stats for the health document: count only here;
+        ``tenant.ShardedRegistry`` overrides with shard balance."""
+        return {"count": self.count()}
 
     def unregister(self, name: str) -> None:
         with self._lock:

@@ -42,6 +42,12 @@ the process boundary, and echo ``X-Trace-Id`` back.
   timing out (docs/resilience.md).
 * ``GET /metrics`` → the obs aggregate snapshot in Prometheus text
   format (obs/export.py; docs/observability.md).
+* ``GET /tenantz`` → per-tenant quota/SLO census, pager state, and
+  registry shard balance on a multi-tenant host
+  (hpnn_tpu/tenant/; docs/tenancy.md); 404 on a plain session.
+  ``/v1/infer`` on such a host routes by the ``X-Tenant`` request
+  header (absent → the default tenant) and a quota rejection's 429
+  body carries ``reason="quota"`` plus the tenant name.
 
 SIGTERM graceful drain: :func:`install_drain` chains a handler that
 stops admission (readiness flips, new arrivals get 503 +
@@ -213,10 +219,20 @@ class Session:
     def ready_doc(self) -> dict:
         return {"ready": self._ready, "reason": self._ready_reason}
 
+    # above this many kernels the health document summarizes (counts
+    # + worst offenders) instead of enumerating — a 10k-kernel host
+    # must not pay an O(n) namespace scan per /healthz scrape
+    # (docs/tenancy.md)
+    HEALTH_LIST_MAX = 64
+
     def health(self) -> dict:
         """The /healthz document: kernel census, bucket-compile census,
         per-batcher queue depth + oldest-waiter age + cumulative
-        shed/expired counters, and the SLO verdict (obs/slo.py)."""
+        shed/expired counters, and the SLO verdict (obs/slo.py).
+        Past ``HEALTH_LIST_MAX`` kernels, the per-kernel sections
+        summarize: the kernel list becomes a census + sample, the
+        batcher map keeps only totals + the worst offenders by queue
+        depth, and the numerics/precision scans run on the sample."""
         with self._lock:
             batchers = dict(self._batchers)
         cache = self.engine.cache_stats()
@@ -225,27 +241,59 @@ class Session:
             # the cross-process executable cache census — present only
             # when HPNN_COMPILE_CACHE_DIR is set (docs/serving.md)
             cache["persistent"] = persistent
-        doc = {
-            "status": "ok",
-            "live": True,
-            "ready": self._ready,
-            "ready_reason": self._ready_reason,
-            "kernels": self.registry.names(),
-            "buckets": list(self.engine.buckets),
-            "compiled": self.engine.compiled_count(),
-            "compile_cache": cache,
-            "batchers": {
+        n_kernels = self.registry.count()
+        big = n_kernels > self.HEALTH_LIST_MAX
+        if big:
+            kernels_doc: object = dict(self.registry.census(),
+                                       sample=self.registry.sample(16))
+            probe_names = self.registry.sample(16)
+        else:
+            kernels_doc = self.registry.names()
+            probe_names = kernels_doc
+        if len(batchers) > self.HEALTH_LIST_MAX:
+            ranked = sorted(batchers.items(),
+                            key=lambda kv: kv[1].depth(), reverse=True)
+            batchers_doc: object = {
+                "count": len(batchers),
+                "depth_total": sum(b.depth()
+                                   for _n, b in ranked),
+                "shed_total": sum(sum(b.shed_counts().values())
+                                  for _n, b in ranked),
+                "expired_total": sum(b.expired_total()
+                                     for _n, b in ranked),
+                "worst": {
+                    name: {"depth": b.depth(),
+                           "oldest_wait_s": b.oldest_age(),
+                           "shed": b.shed_counts(),
+                           "expired": b.expired_total()}
+                    for name, b in ranked[:8]
+                },
+            }
+        else:
+            batchers_doc = {
                 name: {"depth": b.depth(),
                        "oldest_wait_s": b.oldest_age(),
                        "shed": b.shed_counts(),
                        "expired": b.expired_total()}
                 for name, b in batchers.items()
-            },
+            }
+        doc = {
+            "status": "ok",
+            "live": True,
+            "ready": self._ready,
+            "ready_reason": self._ready_reason,
+            "kernels": kernels_doc,
+            "kernel_count": n_kernels,
+            "buckets": list(self.engine.buckets),
+            "compiled": self.engine.compiled_count(),
+            "compile_cache": cache,
+            "batchers": batchers_doc,
         }
-        doc["numerics"] = obs.probes.health_doc(self.registry.names())
+        doc["numerics"] = obs.probes.health_doc(probe_names)
         # per-kernel serve precision policy + measured quant_err bound
         # (engine.precision_doc; docs/performance.md)
-        doc["precision"] = self.engine.precision_doc()
+        doc["precision"] = self.engine.precision_doc(
+            probe_names if big else None)
         doc["obs"] = obs.export.health()
         doc["slo"] = obs.slo.health_doc()
         doc["alerts"] = obs.alerts.health_doc()
@@ -458,6 +506,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/readyz":
             if not self._not_ready():
                 self._reply(200, self.session.ready_doc())
+        elif self.path == "/tenantz":
+            # per-tenant quota/SLO census + pager + shard balance;
+            # 404 on a host without tenancy (plain Session)
+            tenant_doc = getattr(self.session, "tenant_doc", None)
+            if tenant_doc is None:
+                self._reply(404, {"error": "tenancy not enabled"})
+            else:
+                self._reply(200, tenant_doc())
         elif self.path == "/metrics":
             body, ctype = obs.export.metrics_response(
                 self.headers.get("Accept"))
@@ -536,9 +592,19 @@ class _Handler(BaseHTTPRequestHandler):
             tctx = obs.propagate.Ctx(obs.propagate.new_trace())
         if tctx is not None and tctx.trace:
             rid_hdr["X-Trace-Id"] = tctx.trace
+        # multi-tenant hosts (tenant.TenantSession) route by the
+        # X-Tenant header; a bare Session ignores tenancy entirely
+        tenant = self.headers.get("X-Tenant")
+        infer_for = getattr(self.session, "infer_for", None)
         try:
-            out = self.session.infer(name, inputs, timeout_s=timeout_s,
-                                     req_id=req_id, trace=tctx)
+            if infer_for is not None:
+                out = infer_for(tenant, name, inputs,
+                                timeout_s=timeout_s, req_id=req_id,
+                                trace=tctx)
+            else:
+                out = self.session.infer(name, inputs,
+                                         timeout_s=timeout_s,
+                                         req_id=req_id, trace=tctx)
         except KeyError:
             self._reply(404, {"error": f"unknown kernel {name!r}",
                               "req_id": req_id}, headers=rid_hdr)
@@ -547,6 +613,11 @@ class _Handler(BaseHTTPRequestHandler):
                     "req_id": req_id}
             if isinstance(exc, Shed):
                 body["reason"] = exc.reason
+                # quota sheds name the offending tenant so callers
+                # (and the quota drill) can attribute the rejection
+                shed_tenant = getattr(exc, "tenant", None)
+                if shed_tenant is not None:
+                    body["tenant"] = shed_tenant
             self._reply(429, body,
                         headers={"Retry-After": _retry_after(exc),
                                  **rid_hdr})

@@ -1,0 +1,424 @@
+"""Cold-kernel paging: a bounded resident set over the registry.
+
+10k registered kernels cannot all stay hot — weights, per-bucket
+executables, and batcher state per kernel make RSS linear in the
+namespace.  The :class:`Pager` bounds it: at most ``resident_max``
+kernels are *resident* (registered + compiled); the rest live as
+**paged** entries — their weights in a content-addressed checkpoint
+store (``fileio/checkpoint.py`` format) and their executables in the
+persistent compile cache (``HPNN_COMPILE_CACHE_DIR``).  A request for
+a paged kernel blocks while the pager loads the checkpoint back,
+re-registers it under its **pinned version** (so executable
+identities — ``serve.<kernel>.v<V>.b<B>`` — line up and a warm
+compile cache turns the re-warm into disk reads), and evicts the
+least-recently-used idle kernel to make room.
+
+Store layout (``HPNN_TENANT_PAGE_DIR``), object-store style::
+
+    <dir>/objects/<sha[:2]>/<sha>.ckpt   # content-addressed weights
+    <dir>/index/<digest>.json            # name -> {sha, version, ...}
+
+Objects are addressed by a digest of the weight *bytes* (+ shapes /
+dtypes), so identical weights dedupe across versions and tenants and
+the index metadata — not the checkpoint header — is authoritative for
+name/version on page-in.  The index mirrors the *paged-out* set
+exactly: page-in and promotion drop the entry (a warm boot must never
+adopt weights a live host has since superseded), page-out rewrites
+it.  Because both the object store and the
+compile cache are plain shared directories, a **fresh worker boots
+warm on any host**: :meth:`preload_index` adopts every indexed kernel
+as paged, and the first request pages it in off the shared store
+(docs/tenancy.md "Paging lifecycle").
+
+Correctness contract (the paging tests): a paged-out-then-paged-in
+kernel answers **bitwise** identically to one never evicted (parity
+mode — checkpoints round-trip exact bytes, versions are pinned); a
+promotion landing on a paged-out kernel pages it in first; an infer
+racing a page-out blocks on the pager lock and pages back in — never
+a 404.  In-flight kernels are pin-counted and never evicted.
+
+Page transitions emit ``tenant.page_out`` / ``tenant.page_in``
+(counts), ``tenant.page_in_ms`` (the measured cold-hit latency
+histogram the bench gates p99 on), and the ``tenant.resident`` gauge
+carrying its ``cap`` — the bounded-RSS invariant, lintable per record
+(``check_obs_catalog --tenant``).  stdlib + numpy only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+import numpy as np
+
+from hpnn_tpu import obs
+from hpnn_tpu.fileio.checkpoint import (CheckpointError, dump_checkpoint,
+                                        load_checkpoint)
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.serve import compile_cache
+
+ENV_RESIDENT = "HPNN_TENANT_RESIDENT"
+ENV_PAGE_DIR = "HPNN_TENANT_PAGE_DIR"
+
+
+class PagingError(RuntimeError):
+    pass
+
+
+def _resident_from_env() -> int:
+    raw = os.environ.get(ENV_RESIDENT, "").strip()
+    if not raw:
+        return 0
+    n = int(raw)  # junk raises: a silently ignored cap is a lie
+    if n < 0:
+        raise ValueError(f"{ENV_RESIDENT} must be >= 0, got {n}")
+    return n
+
+
+def _weights_digest(weights) -> str:
+    """Content address: sha256 over the raw weight bytes plus shapes/
+    dtypes (two kernels with coincidentally equal bytes but different
+    layer shapes must not collide)."""
+    h = hashlib.sha256()
+    for w in weights:
+        a = np.ascontiguousarray(np.asarray(w))
+        h.update(repr((tuple(a.shape), a.dtype.str)).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _index_key(name: str) -> str:
+    """Index filename for ``name`` — hashed, because kernel names
+    carry tenant scopes (``tenant:kernel``) and arbitrary bytes that
+    must not leak into filesystem semantics."""
+    return hashlib.sha256(name.encode("utf-8",
+                                      "surrogatepass")).hexdigest()[:32]
+
+
+def _tenant_of(name: str) -> str | None:
+    """The tenant scope of a ``tenant:kernel`` name, for event tags."""
+    return name.split(":", 1)[0] if ":" in name else None
+
+
+class _Pin:
+    """Context manager from :meth:`Pager.pin`: holds the kernel
+    resident for the duration; ``cold_ms`` is the measured page-in
+    latency, or None on a warm hit."""
+
+    __slots__ = ("_pager", "name", "cold_ms")
+
+    def __init__(self, pager: "Pager", name: str):
+        self._pager = pager
+        self.name = name
+        self.cold_ms: float | None = None
+
+    def __enter__(self) -> "_Pin":
+        self.cold_ms = self._pager._acquire(self.name)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._pager._release(self.name)
+        return False
+
+
+class Pager:
+    """LRU resident-set manager over a (sharded) registry + engine.
+
+    ``resident_max`` 0 disables eviction (everything stays resident);
+    ``page_dir`` None disables paging entirely — eviction would lose
+    weights, so a cap without a store raises.  ``warmup`` pre-compiles
+    the bucket menu on page-in (the cold-hit cost is then *measured*,
+    and a warm persistent compile cache pays it from disk)."""
+
+    def __init__(self, registry, engine, *,
+                 resident_max: int | None = None,
+                 page_dir: str | None = None, warmup: bool = True,
+                 clock=time.monotonic):
+        if resident_max is None:
+            resident_max = _resident_from_env()
+        if page_dir is None:
+            page_dir = os.environ.get(ENV_PAGE_DIR) or None
+        if resident_max and not page_dir:
+            raise PagingError(
+                f"{ENV_RESIDENT}={resident_max} needs "
+                f"{ENV_PAGE_DIR}: evicting without a page store "
+                "would drop weights")
+        self.registry = registry
+        self.engine = engine
+        self.resident_max = int(resident_max)
+        self.page_dir = page_dir
+        self.warmup = bool(warmup)
+        self._clock = clock
+        self._lock = obs.lockwatch.lock("tenant.pager")
+        # all four below are guarded by _lock; annotations omitted
+        # because helper methods mutate them with the lock held by
+        # their callers (the engine._stat pattern)
+        self._resident: dict[str, float] = {}   # name -> last touch
+        self._paged: dict[str, dict] = {}       # name -> index entry
+        self._pins: dict[str, int] = {}         # name -> inflight
+        self._cold_ms: list[float] = []         # page-in latencies
+        self._page_ins = 0
+        self._page_outs = 0
+
+    # ------------------------------------------------------------ store
+    def _object_path(self, sha: str) -> str:
+        return os.path.join(self.page_dir, "objects", sha[:2],
+                            f"{sha}.ckpt")
+
+    def _index_path(self, name: str) -> str:
+        return os.path.join(self.page_dir, "index",
+                            f"{_index_key(name)}.json")
+
+    def _write_index(self, name: str, idx: dict) -> None:
+        path = self._index_path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fp:
+            json.dump(idx, fp, sort_keys=True)
+        os.replace(tmp, path)
+
+    def _drop_index(self, name: str) -> None:
+        """The on-disk index mirrors the *paged-out* set exactly: a
+        kernel paged (or promoted) back to resident must drop its
+        entry, or a later warm boot would adopt stale weights."""
+        try:
+            os.unlink(self._index_path(name))
+        except OSError:
+            pass  # never indexed (fresh register), or already gone
+
+    def preload_index(self) -> int:
+        """Adopt every indexed kernel as paged — the warm-boot path: a
+        fresh worker pointed at a shared store serves the whole
+        namespace, paying only a page-in per first touch.  Returns the
+        number adopted (already-resident names are skipped)."""
+        if not self.page_dir:
+            return 0
+        idx_dir = os.path.join(self.page_dir, "index")
+        if not os.path.isdir(idx_dir):
+            return 0
+        adopted = 0
+        for fname in os.listdir(idx_dir):
+            if not fname.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(idx_dir, fname),
+                          encoding="utf-8") as fp:
+                    idx = json.load(fp)
+                name = idx["kernel"]
+            except (OSError, ValueError, KeyError):
+                continue  # torn index entry: skip, never crash a boot
+            with self._lock:
+                if name in self._resident or name in self._paged:
+                    continue
+                self._paged[name] = idx
+                adopted += 1
+        obs.event("tenant.preload", adopted=adopted)
+        return adopted
+
+    # ------------------------------------------------------------ paging
+    def _page_out_locked(self, name: str) -> None:
+        # caller holds _lock
+        entry = self.registry.get(name)
+        sha = _weights_digest(entry.kernel.weights)
+        obj = self._object_path(sha)
+        if not os.path.exists(obj):
+            os.makedirs(os.path.dirname(obj), exist_ok=True)
+            dump_checkpoint(obj, name, entry.kernel.weights,
+                            version=entry.version, model=entry.model,
+                            meta={"precision": entry.precision})
+        idx = {"kernel": name, "sha": sha,
+               "version": entry.version, "model": entry.model,
+               "precision": entry.precision}
+        self._write_index(name, idx)
+        self.registry.unregister(name)
+        self.engine.evict(name)
+        del self._resident[name]
+        self._paged[name] = idx
+        self._page_outs += 1
+        obs.count("tenant.page_out", kernel=name,
+                  tenant=_tenant_of(name))
+        self._gauge_resident_locked()
+
+    def _page_in_locked(self, name: str) -> float:
+        # caller holds _lock; returns the measured cold-hit ms
+        idx = self._paged[name]
+        t0 = time.perf_counter()
+        obj = self._object_path(idx["sha"])
+        try:
+            _cname, arrays, _header = load_checkpoint(obj)
+        except CheckpointError as exc:
+            raise PagingError(
+                f"page-in of {name!r} failed: {exc}") from exc
+        kernel = kernel_mod.Kernel(tuple(arrays))
+        # the version pin: executable identities and the persistent
+        # compile-cache keys must match the pre-eviction ones
+        self.registry.register(name, kernel, model=idx["model"],
+                               version=idx["version"],
+                               precision=idx.get("precision"))
+        if self.warmup:
+            self.engine.warmup([name])
+        cold_ms = (time.perf_counter() - t0) * 1000.0
+        del self._paged[name]
+        self._drop_index(name)
+        self._resident[name] = self._clock()
+        self._cold_ms.append(cold_ms)
+        if len(self._cold_ms) > 4096:
+            del self._cold_ms[:2048]
+        self._page_ins += 1
+        obs.count("tenant.page_in", kernel=name,
+                  tenant=_tenant_of(name))
+        obs.observe("tenant.page_in_ms", cold_ms, kernel=name)
+        # no resident gauge here: the set is transiently over cap
+        # until the caller's _evict_over_cap_locked runs, and the
+        # gauge's value<=cap invariant is lintable — publish after
+        return cold_ms
+
+    def _evict_over_cap_locked(self) -> None:
+        # caller holds _lock
+        if not self.resident_max:
+            return
+        while len(self._resident) > self.resident_max:
+            victim = None
+            for cand, _t in sorted(self._resident.items(),
+                                   key=lambda kv: kv[1]):
+                if not self._pins.get(cand):
+                    victim = cand
+                    break
+            if victim is None:
+                return  # everything is in flight; cap yields to pins
+            self._page_out_locked(victim)
+
+    def _gauge_resident_locked(self) -> None:
+        # pinned rides along because pins legitimately hold the set
+        # over cap (the cap yields to in-flight requests): the
+        # lintable invariant is value <= cap + pinned
+        obs.gauge("tenant.resident", float(len(self._resident)),
+                  cap=self.resident_max, paged=len(self._paged),
+                  pinned=len(self._pins))
+
+    # ------------------------------------------------------------ surface
+    def track(self, name: str) -> None:
+        """Adopt a freshly registered kernel into the resident set,
+        evicting over-cap idle kernels to make room."""
+        with self._lock:
+            if self._paged.pop(name, None) is not None:
+                # re-registered over a paged entry (a promotion): the
+                # on-disk index would now point at stale weights
+                self._drop_index(name)
+            self._resident[name] = self._clock()
+            self._evict_over_cap_locked()
+            self._gauge_resident_locked()
+
+    def pin(self, name: str) -> _Pin:
+        """Hold ``name`` resident for a ``with`` block (pages it in
+        first when cold).  Unknown names pass through untouched — the
+        registry's own KeyError stays the 404 authority."""
+        return _Pin(self, name)
+
+    def _acquire(self, name: str) -> float | None:
+        with self._lock:
+            cold_ms = None
+            if name in self._paged:
+                cold_ms = self._page_in_locked(name)
+            if name in self._resident:
+                self._resident[name] = self._clock()
+                self._pins[name] = self._pins.get(name, 0) + 1
+            if cold_ms is not None:
+                # evict only after the pin above: when every other
+                # resident is pinned, the LRU would otherwise pick the
+                # kernel we just paged in and the caller's infer would
+                # 404 on a name it holds a pin for
+                self._evict_over_cap_locked()
+                self._gauge_resident_locked()
+            return cold_ms
+
+    def _release(self, name: str) -> None:
+        with self._lock:
+            n = self._pins.get(name, 0)
+            if n > 1:
+                self._pins[name] = n - 1
+                return
+            self._pins.pop(name, None)
+            if (self.resident_max
+                    and len(self._resident) > self.resident_max):
+                # a pin-forced over-cap episode ends with its last
+                # pin: re-assert the residency bound here, not at the
+                # next (possibly distant) acquire
+                self._evict_over_cap_locked()
+                self._gauge_resident_locked()
+
+    def is_resident(self, name: str) -> bool:
+        with self._lock:
+            return name in self._resident
+
+    def is_paged(self, name: str) -> bool:
+        with self._lock:
+            return name in self._paged
+
+    # ------------------------------------------------------------ GC
+    def gc_objects(self) -> tuple[int, int]:
+        """Sweep version-churn remainders: delete store objects no
+        index entry references (a promotion on a paged kernel strands
+        its old weights object).  Returns ``(files, bytes)`` removed.
+        Also size-sweeps the persistent compile cache when
+        ``HPNN_COMPILE_CACHE_MAX_MB`` is set."""
+        removed = freed = 0
+        if self.page_dir:
+            live: set[str] = set()
+            idx_dir = os.path.join(self.page_dir, "index")
+            if os.path.isdir(idx_dir):
+                for fname in os.listdir(idx_dir):
+                    try:
+                        with open(os.path.join(idx_dir, fname),
+                                  encoding="utf-8") as fp:
+                            live.add(json.load(fp)["sha"])
+                    except (OSError, ValueError, KeyError):
+                        continue
+            obj_dir = os.path.join(self.page_dir, "objects")
+            if os.path.isdir(obj_dir):
+                for sub in os.listdir(obj_dir):
+                    subdir = os.path.join(obj_dir, sub)
+                    if not os.path.isdir(subdir):
+                        continue
+                    for fname in os.listdir(subdir):
+                        sha = fname.rsplit(".", 1)[0]
+                        if sha in live:
+                            continue
+                        path = os.path.join(subdir, fname)
+                        try:
+                            size = os.path.getsize(path)
+                            os.unlink(path)
+                        except OSError:
+                            continue
+                        removed += 1
+                        freed += size
+        cc_removed, cc_freed = compile_cache.gc()
+        if removed or cc_removed:
+            obs.event("tenant.gc", objects=removed, bytes=freed,
+                      cache_entries=cc_removed, cache_bytes=cc_freed)
+        return removed + cc_removed, freed + cc_freed
+
+    # ------------------------------------------------------------ health
+    def cold_hit_ms(self) -> list[float]:
+        with self._lock:
+            return list(self._cold_ms)
+
+    def health_doc(self) -> dict:
+        with self._lock:
+            cold = sorted(self._cold_ms)
+            doc = {
+                "resident": len(self._resident),
+                "cap": self.resident_max,
+                "paged": len(self._paged),
+                "pinned": sum(1 for v in self._pins.values() if v),
+                "page_ins": self._page_ins,
+                "page_outs": self._page_outs,
+                "store": self.page_dir,
+            }
+        if cold:
+            doc["cold_p50_ms"] = round(cold[len(cold) // 2], 3)
+            doc["cold_p99_ms"] = round(
+                cold[min(len(cold) - 1, int(0.99 * len(cold)))], 3)
+        return doc

@@ -1,0 +1,632 @@
+"""Multi-tenant hosting subsystem (hpnn_tpu/tenant/, docs/tenancy.md).
+
+Acceptance bar (ISSUE): a paged-out-then-paged-in kernel answers
+**bitwise** identically to one never evicted; a promotion landing on a
+paged-out kernel pages it in first and bumps its version; an infer
+racing a page-out blocks on the pager and pages back in — never a
+KeyError/404.  Around that core: registry sharding (stable hash,
+distinct watched locks, O(1) census), quota grammar + token-bucket
+admission with a fake clock, the HTTP edge (``X-Tenant`` routing, the
+429 body naming the tenant, ``/tenantz``, health summarization past
+``HEALTH_LIST_MAX``), the ``--tenant`` sink lint both accepting a live
+run and biting on every schema break, and the loadgen Zipf tenant mix.
+"""
+
+import http.client
+import importlib.util
+import json
+import os
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import ann, kernel as kernel_mod
+from hpnn_tpu.serve.server import make_server
+from hpnn_tpu.tenant.host import TenantSession, scoped
+from hpnn_tpu.tenant.pager import Pager, PagingError
+from hpnn_tpu.tenant.quota import (QuotaEnforcer, QuotaExceeded,
+                                   TenantSpec, parse_tenants)
+from hpnn_tpu.tenant.shards import ShardedRegistry, shard_of
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _kernel(seed=7, n_in=8, hiddens=(5,), n_out=2):
+    k, _ = kernel_mod.generate(seed, n_in, list(hiddens), n_out)
+    return k
+
+
+def _direct_ann(kernel, rows):
+    return np.stack([np.asarray(ann.run(kernel.weights, x))
+                     for x in np.atleast_2d(rows)])
+
+
+def _session(tmp_path, *, resident_max=0, page_dir=None, tenants=None,
+             fleet=False, **kw):
+    """A small TenantSession: tiny bucket menu, short waits, paging
+    warmup off (compiles happen lazily on dispatch — the tests assert
+    weights parity, not compile latency)."""
+    return TenantSession(max_batch=8, n_buckets=2, max_wait_ms=0.5,
+                         fleet=fleet, shards=4,
+                         resident_max=resident_max, page_dir=page_dir,
+                         tenants=tenants, page_warmup=False, **kw)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _read_sink(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ sharding
+def test_shard_of_is_stable_crc32_and_spreads():
+    # replicas must shard identically across processes: the hash is
+    # crc32 of the utf-8 name, never PYTHONHASHSEED-poisoned hash()
+    assert shard_of("acme:k", 16) == zlib.crc32(b"acme:k") % 16
+    assert shard_of("acme:k", 16) == shard_of("acme:k", 16)
+    counts = [0] * 16
+    for i in range(1000):
+        counts[shard_of(f"t{i % 7}:kernel-{i}", 16)] += 1
+    assert min(counts) > 0            # no empty stripe at 1000 names
+    assert max(counts) < 3 * (1000 // 16)   # no degenerate pile-up
+
+
+def test_sharded_registry_surface_census_and_distinct_locks(
+        monkeypatch):
+    from hpnn_tpu.obs import lockwatch
+
+    # armed, the stripes must register as DISTINCT watched locks (the
+    # lock-order watchdog sees serve.registry.s0..s3, not one name)
+    monkeypatch.setenv(lockwatch.ENV_KNOB, "1")
+    lockwatch._reset_for_tests()
+    try:
+        reg = ShardedRegistry(4)
+        lock_names = {s._lock.name for s in reg.shards}
+        assert lock_names == {f"serve.registry.s{i}" for i in range(4)}
+    finally:
+        monkeypatch.delenv(lockwatch.ENV_KNOB, raising=False)
+        lockwatch._reset_for_tests()
+
+    reg = ShardedRegistry(4)
+    names = [f"t{i % 3}:k{i}" for i in range(40)]
+    for i, name in enumerate(names):
+        reg.register(name, _kernel(seed=100 + i))
+    assert reg.count() == 40
+    assert reg.names() == sorted(names)
+    assert reg.get(names[7]).version == 0
+    census = reg.census()
+    assert census["count"] == 40 and census["shards"] == 4
+    assert census["shard_min"] >= 1
+    assert census["shard_min"] <= census["shard_max"]
+    sample = reg.sample(16)
+    assert len(sample) == 16 and set(sample) <= set(names)
+    reg.unregister(names[0])
+    assert reg.count() == 39
+    with pytest.raises(KeyError):
+        reg.get(names[0])
+    with pytest.raises(ValueError):
+        ShardedRegistry(0)
+
+
+# ------------------------------------------------------------ quota
+def test_parse_tenants_grammar():
+    specs = parse_tenants(
+        "acme=gold:rate=50:inflight=8,hog=bronze:rate=5:burst=2,best")
+    assert specs["acme"] == TenantSpec("acme", "gold", 50.0, 8, 0.25)
+    assert specs["hog"].rate_rps == 5.0 and specs["hog"].burst_s == 2.0
+    assert specs["best"].slo_class == "bronze"      # bare name: default
+    assert specs["acme"].target_ms == 25.0
+    assert specs["hog"].target_ms == 400.0
+    # junk raises — a silently dropped quota is an isolation hole
+    for bad in ("x=platinum", "x=gold:wat", "x=gold:speed=9",
+                "=gold:rate=1"):
+        with pytest.raises(ValueError):
+            parse_tenants(bad)
+
+
+def test_quota_rate_bucket_and_inflight_with_fake_clock():
+    clk = FakeClock()
+    q = QuotaEnforcer(
+        {"metered": TenantSpec("metered", "silver", rate_rps=2.0,
+                               burst_s=0.5),
+         "narrow": TenantSpec("narrow", "gold", max_inflight=1)},
+        clock=clk)
+    # rate: burst = max(1, 2*0.5) = 1 token — one admit, then shed
+    q.admit("metered")
+    q.release("metered")
+    with pytest.raises(QuotaExceeded) as ei:
+        q.admit("metered")
+    assert ei.value.reason == "quota" and ei.value.tenant == "metered"
+    assert ei.value.retry_after_s > 0
+    clk.advance(0.5)                  # refill: 0.5s * 2rps = 1 token
+    q.admit("metered")
+    q.release("metered")
+    # inflight: the slot frees on release, not on time
+    q.admit("narrow")
+    with pytest.raises(QuotaExceeded) as ei:
+        q.admit("narrow")
+    assert "inflight" in str(ei.value)
+    q.release("narrow")
+    q.admit("narrow")
+    q.release("narrow")
+    # an undeclared tenant degrades to bronze/uncapped best-effort
+    for _ in range(50):
+        q.admit("anon")
+        q.release("anon")
+    assert q.spec("anon") == TenantSpec("anon")
+    doc = q.health_doc()
+    assert doc["metered"]["slo_class"] == "silver"
+    assert doc["metered"]["shed_rate"] > 0
+    assert doc["narrow"]["inflight"] == 0
+    assert set(doc) == {"metered", "narrow", "anon"}
+
+
+def test_quota_record_publishes_windowed_p99():
+    clk = FakeClock()
+    q = QuotaEnforcer({"t": TenantSpec("t", "gold")}, clock=clk)
+    for ms in range(1, 11):
+        q.admit("t")
+        q.release("t")
+        q.record("t", ms / 1000.0)
+    assert q.p99_ms("t") == pytest.approx(10.0)
+    clk.advance(60.0)                 # the 10s window forgets it all
+    assert q.p99_ms("t") is None
+
+
+# ------------------------------------------------------------ paging
+def test_page_round_trip_is_bitwise_and_version_pinned(tmp_path):
+    store = str(tmp_path / "store")
+    sess = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        ka, kb = _kernel(seed=21), _kernel(seed=22)
+        x = np.linspace(-1.0, 1.0, 8)
+        sess.register_for("t", "a", ka, warmup=False)
+        before = np.asarray(sess.infer_for("t", "a", x))
+        assert np.array_equal(before, _direct_ann(ka, x)[0])
+        v_before = sess.registry.get(scoped("t", "a")).version
+
+        sess.register_for("t", "b", kb, warmup=False)   # evicts a
+        assert sess.pager.is_paged(scoped("t", "a"))
+        assert not sess.pager.is_resident(scoped("t", "a"))
+        # the checkpoint + index landed in the object store
+        assert os.path.isdir(os.path.join(store, "objects"))
+        assert os.listdir(os.path.join(store, "index"))
+
+        after = np.asarray(sess.infer_for("t", "a", x))  # pages in
+        assert np.array_equal(after, before)             # bitwise
+        entry = sess.registry.get(scoped("t", "a"))
+        assert entry.version == v_before                 # pinned
+        assert np.array_equal(
+            np.concatenate([w.ravel() for w in entry.kernel.weights]),
+            np.concatenate([w.ravel() for w in ka.weights]))
+        assert sess.pager.health_doc()["page_ins"] == 1
+        assert sess.pager.health_doc()["page_outs"] >= 1
+    finally:
+        sess.close()
+
+
+def test_promotion_on_paged_out_kernel_pages_in_first(tmp_path):
+    store = str(tmp_path / "store")
+    sess = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        name = scoped("t", "a")
+        ka, ka2, kb = _kernel(seed=31), _kernel(seed=32), _kernel(seed=33)
+        sess.register_for("t", "a", ka, warmup=False)
+        sess.register_for("t", "b", kb, warmup=False)   # a paged out
+        assert sess.pager.is_paged(name)
+
+        entry = sess.install_kernel(name, ka2, warmup=False)
+        assert entry.version == 1     # chained off the real lineage
+        assert sess.pager.is_resident(name)
+        assert not sess.pager.is_paged(name)
+        x = np.linspace(-1.0, 1.0, 8)
+        out = np.asarray(sess.infer_for("t", "a", x))
+        assert np.array_equal(out, _direct_ann(ka2, x)[0])
+    finally:
+        sess.close()
+
+
+def test_concurrent_infer_racing_page_out_never_404(tmp_path):
+    """Three threads alternate over two kernels sharing one resident
+    slot — every request forces the other kernel's eviction, so each
+    infer races a page-out.  Pins must make that race invisible: no
+    KeyError, and every answer bitwise-correct for its kernel."""
+    store = str(tmp_path / "store")
+    sess = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        kernels = {"a": _kernel(seed=41), "b": _kernel(seed=42)}
+        x = np.linspace(-1.0, 1.0, 8)
+        want = {n: _direct_ann(k, x)[0] for n, k in kernels.items()}
+        for n, k in kernels.items():
+            sess.register_for("t", n, k, warmup=False)
+        errs: list = []
+
+        def client(i):
+            try:
+                for j in range(12):
+                    n = "a" if (i + j) % 2 == 0 else "b"
+                    out = np.asarray(
+                        sess.infer_for("t", n, x, timeout_s=30.0))
+                    if not np.array_equal(out, want[n]):
+                        errs.append((n, "mismatch"))
+            except Exception as exc:  # collected, asserted empty below
+                errs.append(repr(exc))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        doc = sess.pager.health_doc()
+        assert doc["page_ins"] >= 2   # the race actually happened
+        assert doc["resident"] <= 1 + doc["pinned"]
+    finally:
+        sess.close()
+
+
+def test_cap_without_store_raises_and_pins_hold_over_cap(tmp_path):
+    with pytest.raises(PagingError):
+        Pager(ShardedRegistry(2), engine=None, resident_max=4,
+              page_dir=None)
+    store = str(tmp_path / "store")
+    sess = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        sess.register_for("t", "a", _kernel(seed=51), warmup=False)
+        sess.register_for("t", "b", _kernel(seed=52), warmup=False)
+        with sess.pager.pin(scoped("t", "b")):    # b in, a out, b pinned
+            with sess.pager.pin(scoped("t", "a")):
+                # both pinned: the cap yields, nothing is evictable
+                assert sess.pager.is_resident(scoped("t", "a"))
+                assert sess.pager.is_resident(scoped("t", "b"))
+                doc = sess.pager.health_doc()
+                assert doc["resident"] == 2 and doc["pinned"] == 2
+            # a's last pin dropped: the bound re-asserts immediately
+            # (b is still held, so a is the only candidate)
+            assert sess.pager.is_paged(scoped("t", "a"))
+            assert sess.pager.is_resident(scoped("t", "b"))
+        assert sess.pager.health_doc()["resident"] == 1
+    finally:
+        sess.close()
+
+
+def test_warm_boot_adopts_index_and_drops_it_on_page_in(tmp_path):
+    store = str(tmp_path / "store")
+    ka = _kernel(seed=61)
+    x = np.linspace(-1.0, 1.0, 8)
+    s1 = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        s1.register_for("t", "a", ka, warmup=False)
+        s1.register_for("t", "b", _kernel(seed=62), warmup=False)
+        assert s1.pager.is_paged(scoped("t", "a"))
+    finally:
+        s1.close()
+    # a fresh worker on the shared store boots warm: the index entry
+    # pages a in off disk, bitwise-equal to the original weights
+    s2 = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        assert s2.pager.is_paged(scoped("t", "a"))
+        out = np.asarray(s2.infer_for("t", "a", x))
+        assert np.array_equal(out, _direct_ann(ka, x)[0])
+        assert s2.registry.get(scoped("t", "a")).version == 0
+    finally:
+        s2.close()
+    # the page-in dropped the index entry (it now mirrors nothing
+    # paged out), so a third boot must NOT adopt stale weights
+    s3 = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        assert not s3.pager.is_paged(scoped("t", "a"))
+        with pytest.raises(KeyError):
+            s3.infer_for("t", "a", x)
+    finally:
+        s3.close()
+
+
+def test_gc_objects_sweeps_stranded_weights(tmp_path):
+    store = str(tmp_path / "store")
+    sess = _session(tmp_path, resident_max=1, page_dir=store)
+    try:
+        name = scoped("t", "a")
+        sess.register_for("t", "a", _kernel(seed=71), warmup=False)
+        sess.register_for("t", "b", _kernel(seed=72), warmup=False)
+        assert sess.pager.is_paged(name)
+        # promotion pages a in (dropping its index) and strands a's
+        # old weights object; b gets paged out in its stead
+        sess.install_kernel(name, _kernel(seed=73), warmup=False)
+
+        def objects():
+            found = []
+            for sub, _dirs, files in os.walk(
+                    os.path.join(store, "objects")):
+                found += [os.path.join(sub, f) for f in files]
+            return sorted(found)
+
+        before = objects()
+        assert len(before) == 2       # a's stale v0 + b's live object
+        removed, freed = sess.pager.gc_objects()
+        assert removed == 1 and freed > 0
+        after = objects()
+        assert len(after) == 1 and set(after) <= set(before)
+        # the survivor is still pageable: b comes back bitwise-clean
+        x = np.linspace(-1.0, 1.0, 8)
+        out = np.asarray(sess.infer_for("t", "b", x))
+        assert np.array_equal(out, _direct_ann(_kernel(seed=72), x)[0])
+    finally:
+        sess.close()
+
+
+# ------------------------------------------------------------ HTTP edge
+def test_http_x_tenant_routing_quota_429_and_tenantz(tmp_path):
+    k = _kernel(seed=81)
+    sess = _session(
+        tmp_path, fleet=True,
+        tenants={"acme": TenantSpec("acme", "gold"),
+                 "hog": TenantSpec("hog", "bronze", rate_rps=0.5,
+                                   burst_s=0.1)})
+    server = make_server(sess)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        sess.register_for("acme", "k", k, warmup=False)
+        sess.register_for("hog", "k", k, warmup=False)
+        cn = http.client.HTTPConnection(host, port, timeout=30)
+        x = np.linspace(-1.0, 1.0, 8)
+        body = json.dumps({"kernel": "k", "inputs": x.tolist()})
+
+        def infer(tenant):
+            hdrs = {"Content-Type": "application/json"}
+            if tenant:
+                hdrs["X-Tenant"] = tenant
+            cn.request("POST", "/v1/infer", body=body, headers=hdrs)
+            resp = cn.getresponse()
+            return resp, json.loads(resp.read())
+
+        resp, out = infer("acme")
+        assert resp.status == 200
+        assert np.array_equal(np.asarray(out["outputs"]),
+                              _direct_ann(k, x)[0])
+        # no header -> the default tenant, which owns no kernels
+        resp, out = infer(None)
+        assert resp.status == 404
+        # hog's bucket holds exactly one token: the second request
+        # inside the same instant is refused, naming the tenant
+        resp, _out = infer("hog")
+        assert resp.status == 200
+        resp, out = infer("hog")
+        assert resp.status == 429
+        assert out["reason"] == "quota" and out["tenant"] == "hog"
+        assert out["retriable"] is True
+        assert resp.getheader("Retry-After") is not None
+
+        cn.request("GET", "/tenantz")
+        resp = cn.getresponse()
+        doc = json.loads(resp.read())
+        assert resp.status == 200
+        assert set(doc) == {"tenants", "pager", "registry"}
+        assert doc["tenants"]["acme"]["slo_class"] == "gold"
+        assert doc["tenants"]["hog"]["shed_rate"] > 0
+        assert doc["registry"]["count"] == 2
+        cn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+
+
+def test_tenantz_is_404_on_a_plain_session():
+    sess = serve.Session(max_batch=8, n_buckets=2)
+    server = make_server(sess)
+    host, port = server.server_address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        cn = http.client.HTTPConnection(host, port, timeout=10)
+        cn.request("GET", "/tenantz")
+        assert cn.getresponse().status == 404
+        cn.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+
+
+def test_health_summarizes_past_health_list_max(tmp_path):
+    sess = _session(tmp_path, fleet=True)
+    try:
+        limit = serve.Session.HEALTH_LIST_MAX
+        rng = np.random.RandomState(5)
+        for i in range(limit):
+            k = kernel_mod.Kernel((rng.standard_normal((4, 6)),
+                                   rng.standard_normal((2, 4))))
+            sess.register_for(f"t{i % 4}", f"k{i}", k, warmup=False)
+        doc = sess.health()
+        assert isinstance(doc["kernels"], list)      # at the limit
+        assert len(doc["kernels"]) == limit
+        assert doc["tenancy"]["registry"]["count"] == limit
+
+        k = kernel_mod.Kernel((rng.standard_normal((4, 6)),
+                               rng.standard_normal((2, 4))))
+        sess.register_for("t0", "overflow", k, warmup=False)
+        doc = sess.health()
+        kd = doc["kernels"]                          # one past: census
+        assert isinstance(kd, dict)
+        assert kd["count"] == limit + 1
+        assert 0 < len(kd["sample"]) <= 16
+        assert kd["shard_min"] <= kd["shard_max"]
+    finally:
+        sess.close()
+
+
+# ------------------------------------------------------------ sink lint
+def test_live_tenant_sink_lints_clean(tmp_path):
+    """The real emission path must satisfy its own lint: a short run
+    with paging, quota sheds, and enough outcomes to publish the p99
+    gauges produces a sink ``--tenant`` accepts."""
+    mod = _load_tool("check_obs_catalog")
+    sink = tmp_path / "obs.jsonl"
+    store = str(tmp_path / "store")
+    obs.configure(str(sink))
+    try:
+        sess = _session(
+            tmp_path, resident_max=1, page_dir=store,
+            tenants={"t": TenantSpec("t", "gold"),
+                     "hog": TenantSpec("hog", "bronze", rate_rps=0.5,
+                                       burst_s=0.1)})
+        try:
+            sess.register_for("t", "a", _kernel(seed=91), warmup=False)
+            sess.register_for("t", "b", _kernel(seed=92), warmup=False)
+            sess.register_for("hog", "h", _kernel(seed=93),
+                              warmup=False)
+            x = np.linspace(-1.0, 1.0, 8)
+            for i in range(10):       # past PUBLISH_EVERY: p99 lands
+                sess.infer_for("t", "a" if i % 2 else "b", x)
+            sess.infer_for("hog", "h", x)
+            with pytest.raises(QuotaExceeded):
+                sess.infer_for("hog", "h", x)
+        finally:
+            sess.close()
+    finally:
+        obs.configure(None)
+    names = {r["ev"] for r in _read_sink(sink)}
+    for want in ("tenant.page_out", "tenant.page_in",
+                 "tenant.page_in_ms", "tenant.resident",
+                 "tenant.p99_ms", "tenant.shed_rate", "tenant.shed",
+                 "tenant.inflight", "tenant.close"):
+        assert want in names, f"missing {want} in {sorted(names)}"
+    assert mod.lint_tenant(str(sink)) == []
+    assert mod.main(["--tenant", str(sink)]) == 0
+
+
+def _write_sink(path, rows):
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+
+def _tenant_rows():
+    return [
+        {"ev": "tenant.page_out", "kind": "count", "n": 1,
+         "kernel": "t:a", "tenant": "t"},
+        {"ev": "tenant.page_in", "kind": "count", "n": 1,
+         "kernel": "t:a", "tenant": "t"},
+        {"ev": "tenant.page_in_ms", "kind": "hist", "value": 3.2,
+         "kernel": "t:a"},
+        {"ev": "tenant.resident", "kind": "gauge", "value": 2.0,
+         "cap": 2, "paged": 5, "pinned": 0},
+        # pins legitimately hold the set over cap: value <= cap+pinned
+        {"ev": "tenant.resident", "kind": "gauge", "value": 3.0,
+         "cap": 2, "paged": 4, "pinned": 1},
+        {"ev": "tenant.p99_ms", "kind": "gauge", "value": 12.5,
+         "tenant": "acme", "slo_class": "gold", "target_ms": 25.0},
+        {"ev": "tenant.shed_rate", "kind": "gauge", "value": 0.25,
+         "tenant": "hog"},
+        {"ev": "serve.shed", "kind": "count", "n": 1,
+         "reason": "quota", "tenant": "hog", "over": "rate"},
+    ]
+
+
+def test_tenant_lint_accepts_a_well_formed_sink(tmp_path):
+    mod = _load_tool("check_obs_catalog")
+    path = tmp_path / "tenant.jsonl"
+    _write_sink(path, _tenant_rows())
+    assert mod.lint_tenant(str(path)) == []
+
+
+def test_tenant_lint_catches_every_schema_break(tmp_path):
+    """Each clause bites: wrong kinds, anonymous paging/shed records,
+    a resident gauge over cap (with and without pin slack), a bad SLO
+    class, and a shed rate outside [0, 1]."""
+    mod = _load_tool("check_obs_catalog")
+    path = tmp_path / "tenant.jsonl"
+    breaks = [
+        ({"ev": "tenant.page_in", "kind": "event", "kernel": "t:a"},
+         "!= 'count'"),
+        ({"ev": "tenant.page_out", "kind": "count", "kernel": ""},
+         "non-empty"),
+        ({"ev": "tenant.page_in_ms", "kind": "gauge", "value": 3.2},
+         "!= 'hist'"),
+        ({"ev": "tenant.resident", "kind": "gauge", "value": -1.0,
+          "cap": 2}, "finite non-negative"),
+        ({"ev": "tenant.resident", "kind": "gauge", "value": 4.0,
+          "cap": 2, "pinned": 1}, "exceeds"),
+        ({"ev": "tenant.resident", "kind": "gauge", "value": 3.0,
+          "cap": 2}, "exceeds"),
+        ({"ev": "tenant.p99_ms", "kind": "gauge", "value": 9.0,
+          "tenant": "t", "slo_class": "platinum"}, "slo_class"),
+        ({"ev": "tenant.p99_ms", "kind": "gauge", "value": 9.0,
+          "tenant": "", "slo_class": "gold"}, "non-empty"),
+        ({"ev": "tenant.shed_rate", "kind": "gauge", "value": 1.5,
+          "tenant": "t"}, "[0, 1]"),
+        ({"ev": "tenant.shed_rate", "kind": "gauge", "value": 0.5},
+         "non-empty"),
+        ({"ev": "serve.shed", "kind": "count", "reason": "quota",
+          "tenant": ""}, "whose budget"),
+    ]
+    for rec, needle in breaks:
+        _write_sink(path, [rec])
+        failures = mod.lint_tenant(str(path))
+        assert failures, f"schema break not caught: {rec}"
+        assert any(needle in f for f in failures), (needle, failures)
+
+
+def test_tenant_lint_fails_a_sink_with_no_tenant_records(tmp_path):
+    mod = _load_tool("check_obs_catalog")
+    path = tmp_path / "quiet.jsonl"
+    _write_sink(path, [{"ev": "obs.summary", "kind": "summary"}])
+    assert any("no tenant records" in f
+               for f in mod.lint_tenant(str(path)))
+    _write_sink(path, _tenant_rows()[:1] + [
+        {"ev": "tenant.resident", "kind": "gauge", "value": 9.0,
+         "cap": 2, "pinned": 0}])
+    assert mod.main(["--tenant", str(path)]) == 1
+    assert mod.main(["--tenant"]) == 2
+
+
+# ------------------------------------------------------------ loadgen
+def test_loadgen_zipf_helpers_and_by_tenant_summary():
+    lg = _load_tool("loadgen")
+    assert lg.tenant_names(3) == ("t000", "t001", "t002")
+    cdf = lg.zipf_cdf(8, 1.2)
+    assert len(cdf) == 8
+    assert np.all(np.diff(cdf) > 0)              # strictly increasing
+    assert cdf[-1] == pytest.approx(1.0)
+    rng = np.random.RandomState(3)
+    draws = [lg.zipf_pick(cdf, rng) for _ in range(2000)]
+    assert all(0 <= d < 8 for d in draws)
+    counts = np.bincount(draws, minlength=8)
+    assert counts[0] > 2 * counts[7]             # the skew is real
+    with pytest.raises(ValueError):
+        lg.zipf_cdf(0, 1.2)
+
+    recs = [
+        {"status": "ok", "latency_ms": 1.0, "tenant": "a"},
+        {"status": "ok", "latency_ms": 2.0, "tenant": "a"},
+        {"status": "shed", "latency_ms": 0.1, "tenant": "b"},
+        {"status": "ok", "latency_ms": 1.5},     # untagged: no tenant
+    ]
+    summary = lg.summarize(recs, 1.0)
+    assert summary["by_tenant"] == {
+        "a": {"requests": 2, "ok": 2, "shed": 0},
+        "b": {"requests": 1, "ok": 0, "shed": 1},
+    }
+    # an untenanted run keeps the old summary shape exactly
+    assert "by_tenant" not in lg.summarize(recs[3:], 1.0)

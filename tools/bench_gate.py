@@ -155,6 +155,21 @@ GATE_METRICS = {
     "autoscale_goodput_x": ("higher", 0.30),
     "autoscale_p99_ms": ("lower", 1.00),
     "autoscale_settle_s": ("lower", 1.50),
+    # multi-tenant hosting fold-ins (tools/loadgen.py
+    # run_bench_tenant + tools/chaos_drill.py run_bench_quota_drill;
+    # docs/tenancy.md): registration throughput at 10k-kernel scale,
+    # RSS growth under the resident cap (the bounded-memory claim —
+    # mostly allocator/import noise, so generous), the measured
+    # cold-hit paging p99, goodput under Zipf traffic, and the quota
+    # drill's victim-protection surfaces: the victims' p99 and their
+    # goodput as a fraction of the undisturbed plateau while a
+    # hostile tenant offers 10x its budget
+    "tenant_register_krps": ("higher", 0.40),
+    "tenant_rss_growth_mb": ("lower", 1.00),
+    "tenant_cold_p99_ms": ("lower", 1.00),
+    "tenant_goodput_rps": ("higher", 0.40),
+    "drill_quota_victim_p99_ms": ("lower", 1.50),
+    "drill_quota_victim_goodput_ratio": ("higher", 0.30),
 }
 
 

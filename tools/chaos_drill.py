@@ -1098,6 +1098,170 @@ def drill_drift(workdir: str, *, rate: float = 20.0,
                 os.environ[key] = val
 
 
+def drill_quota(workdir: str, *, rate: float = 100.0, seed: int = 9,
+                phase_s: float = 1.5,
+                offender_rate_rps: float = 40.0,
+                overdrive: float = 10.0) -> dict:
+    """Hostile-tenant drill (docs/tenancy.md): two well-behaved
+    "gold" victims and one rate-capped "bronze" offender share one
+    in-process TenantSession; after an undisturbed baseline the
+    offender offers ``overdrive``x its admission budget.  Proves
+    per-tenant isolation end to end: the victims' goodput and p99
+    hold against their own baseline, every refusal the offender sees
+    is a clean ``shed reason=quota`` carrying its tenant in the sink,
+    and the ``tenant.shed_rate`` threshold rule fires — the PR 12
+    alert grammar watching a per-tenant gauge
+    (``drill_quota_victim_p99_ms`` / ``drill_quota_victim_goodput_
+    ratio`` in bench_gate.py)."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve.batcher import QueueFull
+    from hpnn_tpu.tenant import TenantSession, TenantSpec
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.quota", "ok": False,
+                 "offender_rate_rps": float(offender_rate_rps),
+                 "overdrive": float(overdrive)}
+    sink = os.path.join(workdir, "quota-drill.metrics.jsonl")
+    env_keys = ("HPNN_ALERTS", "HPNN_METRICS")
+    prev_env = {key: os.environ.get(key) for key in env_keys}
+    os.environ["HPNN_ALERTS"] = ("quota_breach@tenant.shed_rate>0.5:"
+                                 "for=0,cooldown=0,severity=warn")
+    victims = ("v-gold-a", "v-gold-b")
+    offender = "hog"
+    specs = {v: TenantSpec(v, "gold") for v in victims}
+    specs[offender] = TenantSpec(offender, "bronze",
+                                 rate_rps=float(offender_rate_rps))
+    session = None
+    try:
+        obs.configure(sink)   # re-reads every knob, arms the rule
+        session = TenantSession(mode="parity", fleet=True,
+                                max_wait_ms=0.5, tenants=specs)
+        k, _ = kernel_mod.generate(seed + 1, 8, [5], 2)
+        for tn in (*victims, offender):
+            # same topology on purpose: the fleet batcher stacks the
+            # tenants' dispatches, so isolation is enforced at
+            # admission, not by accidental executable separation
+            session.register_for(tn, KERNEL, k)
+        x = np.random.RandomState(seed).standard_normal((2, 8))
+        session.infer_for(victims[0], KERNEL, x)  # discarded warmup
+
+        def paced(tenant: str, rate_rps: float, duration_s: float,
+                  res: dict):
+            period = 1.0 / max(rate_rps, 1e-6)
+            t0 = time.perf_counter()
+            i = 0
+            while i * period < duration_s:
+                due = t0 + i * period
+                i += 1
+                delay = due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t_req = time.perf_counter()
+                try:
+                    session.infer_for(tenant, KERNEL, x,
+                                      timeout_s=2.0)
+                except QueueFull as exc:  # Shed subclass
+                    res["shed"] += 1
+                    reason = getattr(exc, "reason", None) or "?"
+                    res["reasons"][reason] = (
+                        res["reasons"].get(reason, 0) + 1)
+                except Exception as exc:
+                    res["errors"] += 1
+                    res["error_sample"] = repr(exc)
+                else:
+                    res["ok"] += 1
+                    res["lat"].append(time.perf_counter() - t_req)
+
+        def fresh():
+            return {"ok": 0, "shed": 0, "errors": 0,
+                    "reasons": {}, "lat": []}
+
+        def victim_wave(duration_s: float) -> dict:
+            res = {v: fresh() for v in victims}
+            threads = [threading.Thread(
+                target=paced, args=(v, rate / len(victims),
+                                    duration_s, res[v]),
+                daemon=True) for v in victims]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return res
+
+        base = victim_wave(phase_s)
+        hog_res = fresh()
+        hog_thread = threading.Thread(
+            target=paced,
+            args=(offender, offender_rate_rps * overdrive,
+                  phase_s, hog_res),
+            daemon=True)
+        hog_thread.start()
+        attack = victim_wave(phase_s)
+        hog_thread.join()
+
+        def agg(res: dict) -> tuple[int, list[float]]:
+            return (sum(r["ok"] for r in res.values()),
+                    [s for r in res.values() for s in r["lat"]])
+
+        base_ok, base_lat = agg(base)
+        atk_ok, atk_lat = agg(attack)
+        census = obs.alerts.health_doc()
+        obs.configure(None)   # close the sink for a complete audit
+        events = []
+        with open(sink) as fp:
+            for line in fp:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        fires = [r for r in events if r.get("ev") == "alert.fire"
+                 and r.get("rule") == "quota_breach"]
+        quota_sheds = [r for r in events
+                       if r.get("ev") == "serve.shed"
+                       and r.get("reason") == "quota"]
+        victim_quota_sheds = [r for r in quota_sheds
+                              if r.get("tenant") != offender]
+        p99 = (lambda lat: round(float(
+            np.percentile(np.asarray(lat) * 1e3, 99)), 3)
+            if lat else None)
+        out["baseline_goodput_rps"] = round(base_ok / phase_s, 1)
+        out["victim_goodput_rps"] = round(atk_ok / phase_s, 1)
+        out["victim_goodput_ratio"] = (
+            round(atk_ok / base_ok, 4) if base_ok else None)
+        out["baseline_p99_ms"] = p99(base_lat)
+        out["victim_p99_ms"] = p99(atk_lat)
+        out["victim_shed"] = sum(r["shed"] for r in attack.values())
+        out["offender_offered"] = (hog_res["ok"] + hog_res["shed"]
+                                   + hog_res["errors"])
+        out["offender_ok"] = hog_res["ok"]
+        out["offender_shed"] = hog_res["shed"]
+        out["offender_shed_reasons"] = dict(
+            sorted(hog_res["reasons"].items()))
+        out["victim_quota_sheds_in_sink"] = len(victim_quota_sheds)
+        out["alert_fired"] = bool(fires)
+        out["fired_total"] = census.get("fired_total", 0)
+        out["ok"] = bool(
+            base_ok and atk_ok
+            and out["victim_goodput_ratio"] is not None
+            and out["victim_goodput_ratio"] >= 0.75
+            and out["victim_shed"] == 0
+            and hog_res["shed"] > 0
+            and set(hog_res["reasons"]) == {"quota"}
+            and not victim_quota_sheds
+            and fires)
+        return out
+    finally:
+        if session is not None:
+            session.close()
+        obs.configure(None)
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
@@ -1107,6 +1271,7 @@ DRILLS = {
     "worker": drill_worker,
     "capsule": drill_capsule,
     "drift": drill_drift,
+    "quota": drill_quota,
 }
 
 
@@ -1251,6 +1416,28 @@ def run_bench_capsule_drill(*, rate: float = 60.0) -> dict:
         "dispatch_blame_pct": row.get("dispatch_blame_pct"),
         "capsule_spans": row.get("capsule_spans"),
         "profile_files": row.get("profile_files"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
+def run_bench_quota_drill(*, rate: float = 100.0) -> dict:
+    """The bench.py fold-in for the quota drill: a hostile tenant at
+    10x its admission budget against a shared TenantSession, reported
+    as gateable numbers (``drill_quota_victim_p99_ms`` /
+    ``drill_quota_victim_goodput_ratio``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_quota(tmp, rate=rate)
+    out = {
+        "metric": "quota_drill",
+        "drill": row,
+        "victim_p99_ms": row.get("victim_p99_ms"),
+        "victim_goodput_ratio": row.get("victim_goodput_ratio"),
+        "offender_shed": row.get("offender_shed"),
+        "alert_fired": row.get("alert_fired"),
         "ok": row.get("ok", False),
     }
     if "skipped" in row:

@@ -119,12 +119,23 @@ kernel is unactionable, and a drift-alert capsule without its
 their shapes are frozen too (docs/observability.md "Drift
 detection").
 
+And the multi-tenant hosting schema lint (:func:`lint_tenant`): the
+``tenant.page_in`` / ``tenant.page_out`` paging edges and
+``tenant.page_in_ms`` cold-hit histogram (hpnn_tpu/tenant/pager.py),
+the ``tenant.resident`` gauge with its cap-bounded residency
+invariant, the ``tenant.p99_ms`` / ``tenant.shed_rate`` per-tenant
+SLO gauges (tenant/quota.py), and ``serve.shed reason=quota``
+refusals that must name their tenant are how an operator audits a
+10k-kernel host — an over-cap residency gauge or an anonymous quota
+shed makes the bounded-memory and isolation claims unverifiable, so
+their shapes are frozen too (docs/tenancy.md).
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
         [--serve-replicas PATH] [--fleet PATH] [--cluster PATH]
-        [--forensics PATH] [--drift PATH]
+        [--forensics PATH] [--drift PATH] [--tenant PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -152,7 +163,8 @@ DOC_RE = re.compile(
 
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
              "docs/fleet.md", "docs/online.md", "docs/resilience.md",
-             "docs/performance.md", "docs/analysis.md")
+             "docs/performance.md", "docs/analysis.md",
+             "docs/tenancy.md")
 SRC_DIR = "hpnn_tpu"
 
 
@@ -1897,6 +1909,130 @@ def lint_drift(path: str) -> list[str]:
     return failures
 
 
+TENANT_CLASSES = ("gold", "silver", "bronze")
+
+
+def lint_tenant(path: str) -> list[str]:
+    """Schema-lint the multi-tenant hosting records of one metrics
+    sink (a run against a ``TenantSession`` — docs/tenancy.md).
+
+    Checks, per record:
+
+    * ``tenant.page_in`` / ``tenant.page_out`` counts — ``kind ==
+      "count"``; a non-empty ``kernel`` (an anonymous paging event
+      cannot be attributed to a tenant's working set).
+    * ``tenant.page_in_ms`` — ``kind == "hist"`` (the cold-hit
+      latency distribution the bench gates on).
+    * ``tenant.resident`` gauges — finite ``value`` >= 0, and when a
+      positive ``cap`` rides along, ``value <= cap + pinned``: the
+      LRU's bounded-residency invariant, made lintable (pins hold
+      in-flight kernels over cap by design).
+    * ``tenant.p99_ms`` gauges — finite non-negative ``value``,
+      non-empty ``tenant``, ``slo_class`` one of gold/silver/bronze.
+    * ``tenant.shed_rate`` gauges — ``value`` in [0, 1], non-empty
+      ``tenant`` (an anonymous shed rate can't drive a per-tenant
+      alert).
+    * ``serve.shed`` counts with ``reason == "quota"`` — a non-empty
+      ``tenant`` (the refusal must name whose budget it enforced).
+
+    A sink with no tenant records fails — this lint only makes sense
+    on a tenancy-armed run.  Returns failure strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_tenant = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev in ("tenant.page_in", "tenant.page_out"):
+            n_tenant += 1
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} "
+                    "!= 'count'")
+            k = rec.get("kernel")
+            if not isinstance(k, str) or not k:
+                failures.append(
+                    f"{at}: {ev} kernel {k!r} is not a non-empty "
+                    "string")
+        elif ev == "tenant.page_in_ms":
+            n_tenant += 1
+            if rec.get("kind") != "hist":
+                failures.append(
+                    f"{at}: tenant.page_in_ms kind "
+                    f"{rec.get('kind')!r} != 'hist'")
+        elif ev == "tenant.resident":
+            n_tenant += 1
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: tenant.resident value {v!r} is not a "
+                    "finite non-negative number")
+            cap = rec.get("cap")
+            pinned = rec.get("pinned")
+            slack = pinned if _num(pinned) and pinned > 0 else 0
+            if (_num(v) and math.isfinite(v) and _num(cap)
+                    and cap > 0 and v > cap + slack):
+                failures.append(
+                    f"{at}: tenant.resident value {v!r} exceeds its "
+                    f"cap {cap!r} (+{slack} pinned) — the paging "
+                    "LRU's bounded-residency invariant is broken")
+        elif ev == "tenant.p99_ms":
+            n_tenant += 1
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: tenant.p99_ms value {v!r} is not a "
+                    "finite non-negative number")
+            t = rec.get("tenant")
+            if not isinstance(t, str) or not t:
+                failures.append(
+                    f"{at}: tenant.p99_ms tenant {t!r} is not a "
+                    "non-empty string")
+            if rec.get("slo_class") not in TENANT_CLASSES:
+                failures.append(
+                    f"{at}: tenant.p99_ms slo_class "
+                    f"{rec.get('slo_class')!r} not in "
+                    f"{'/'.join(TENANT_CLASSES)}")
+        elif ev == "tenant.shed_rate":
+            n_tenant += 1
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or not 0 <= v <= 1:
+                failures.append(
+                    f"{at}: tenant.shed_rate value {v!r} is not a "
+                    "number in [0, 1]")
+            t = rec.get("tenant")
+            if not isinstance(t, str) or not t:
+                failures.append(
+                    f"{at}: tenant.shed_rate tenant {t!r} is not a "
+                    "non-empty string")
+        elif ev == "serve.shed" and rec.get("reason") == "quota":
+            n_tenant += 1
+            t = rec.get("tenant")
+            if not isinstance(t, str) or not t:
+                failures.append(
+                    f"{at}: serve.shed reason=quota tenant {t!r} is "
+                    "not a non-empty string — a quota refusal must "
+                    "name whose budget it enforced")
+    if not n_tenant:
+        failures.append(
+            f"sink {path!r} has no tenant records — did this run "
+            "host kernels through a TenantSession?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -1975,6 +2111,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_drift(argv[i + 1])
+    if "--tenant" in argv:
+        i = argv.index("--tenant")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --tenant needs a "
+                             "path\n")
+            return 2
+        failures += lint_tenant(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

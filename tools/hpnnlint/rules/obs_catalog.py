@@ -35,7 +35,8 @@ ROW_RE = re.compile(
 
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
              "docs/fleet.md", "docs/online.md", "docs/resilience.md",
-             "docs/performance.md", "docs/analysis.md")
+             "docs/performance.md", "docs/analysis.md",
+             "docs/tenancy.md")
 
 
 def _covered(name: str, documented: set[str]) -> bool:

@@ -38,8 +38,17 @@ server-side spans parent across the process boundary and the report
 stitches one client → edge → replica tree per request
 (docs/observability.md "Fleet telemetry").
 
+Multi-tenant traffic: ``--tenants N`` spreads requests over N
+synthetic tenants (``t000``..) drawn from a Zipf distribution
+(``--zipf S``, heavier S = hotter head — real tenant populations are
+head-heavy, and that skew is exactly what exercises a host's paging
+LRU and per-tenant quotas, docs/tenancy.md).  Each request carries
+its tenant in the ``X-Tenant`` header and its outcome row; the
+summary adds a per-tenant census.
+
 Outcome rows: ``{"t", "kernel", "rows", "status": ok|shed|timeout|
-error|lost, "code", "latency_ms", "req_id", "attempts"}``; the summary
+error|lost, "code", "latency_ms", "req_id", "attempts"}`` (plus
+``tenant`` under ``--tenants``); the summary
 (ONE JSON line on stdout, the bench.py convention) reports
 p50/p99/p99.9 of *served* latencies, goodput vs offered load, and
 shed/timeout rates.  :func:`run_bench_load` is the self-contained
@@ -126,7 +135,17 @@ def summarize(records: list[dict], duration_s: float, *,
     goodput = counts["ok"] / duration_s if duration_s else 0.0
     if offered_rps is None:
         offered_rps = n / duration_s if duration_s else 0.0
-    return {
+    by_tenant: dict[str, dict] = {}
+    for r in records:
+        t = r.get("tenant")
+        if t is None:
+            continue
+        d = by_tenant.setdefault(
+            t, {"requests": 0, "ok": 0, "shed": 0})
+        d["requests"] += 1
+        if r["status"] in d:
+            d[r["status"]] += 1
+    out = {
         "requests": n,
         "duration_s": round(duration_s, 3),
         "offered_rps": round(offered_rps, 1),
@@ -144,6 +163,9 @@ def summarize(records: list[dict], duration_s: float, *,
         "ops": ops,
         "latency_ms": latency_summary(ok_lat_s),
     }
+    if by_tenant:
+        out["by_tenant"] = dict(sorted(by_tenant.items()))
+    return out
 
 
 def write_jsonl(path: str, records: list[dict], summary: dict) -> None:
@@ -199,6 +221,30 @@ def make_arrivals(process: str, rate_rps: float, duration_s: float,
     if process == "burst":
         return burst_arrivals(rate_rps, duration_s, rng)
     raise ValueError(f"unknown arrival process {process!r}")
+
+
+# ------------------------------------------------------------ tenants
+
+
+def tenant_names(n: int) -> tuple[str, ...]:
+    """``t000``.. — the synthetic tenant namespace of ``--tenants``."""
+    return tuple(f"t{i:03d}" for i in range(int(n)))
+
+
+def zipf_cdf(n: int, s: float) -> np.ndarray:
+    """Cumulative Zipf(s) weights over ranks 0..n-1: item ``i`` draws
+    with probability proportional to ``1/(i+1)^s``.  A draw is
+    ``searchsorted(cdf, uniform())`` — O(log n) per request, so a 10k
+    kernel namespace costs the generator nothing."""
+    if n < 1:
+        raise ValueError("zipf_cdf needs n >= 1")
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64),
+                       float(s))
+    return np.cumsum(w / w.sum())
+
+
+def zipf_pick(cdf: np.ndarray, rng: np.random.RandomState) -> int:
+    return int(np.searchsorted(cdf, rng.uniform(), side="right"))
 
 
 # ------------------------------------------------------------ client
@@ -283,7 +329,8 @@ class _Client:
 
     def request(self, kernel: str, rows: int, body: bytes, *,
                 max_retries: int = 2, retry_cap_s: float = 1.0,
-                path: str = "/v1/infer", op: str = "infer") -> dict:
+                path: str = "/v1/infer", op: str = "infer",
+                tenant: str | None = None) -> dict:
         """Issue one logical request (with 429/503 retries); returns
         its outcome row (latency spans all attempts, sleeps included).
         ``path``/``op`` route the mixed-traffic mode: infer requests
@@ -306,6 +353,9 @@ class _Client:
             if ctx is not None:
                 trace = ctx.trace
                 hdrs = propagate.inject({}, ctx)
+        if tenant is not None:
+            hdrs = dict(hdrs or {})
+            hdrs["X-Tenant"] = tenant
         t_start = time.perf_counter()
         while True:
             attempts += 1
@@ -354,6 +404,8 @@ class _Client:
             "req_id": req_id,
             "attempts": attempts,
         }
+        if tenant is not None:
+            rec["tenant"] = tenant
         if trace is not None:
             rec["trace"] = trace
         return rec
@@ -402,6 +454,7 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
                   max_retries: int = 2, retry_cap_s: float = 1.0,
                   n_workers: int = 16, seed: int = 0,
                   ingest_frac: float = 0.0, n_out: int = 2,
+                  tenants: int = 0, zipf_s: float = 1.1,
                   out_path: str | None = None,
                   stop: "threading.Event | None" = None,
                   on_record=None) -> dict:
@@ -419,13 +472,16 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
     bodies = _request_bodies(kernels, rows_choices, n_in, timeout_s)
     feed_bodies = (_ingest_bodies(kernels, rows_choices, n_in, n_out,
                                   seed) if ingest_frac > 0 else {})
+    tnames = tenant_names(tenants) if tenants > 0 else ()
+    tcdf = zipf_cdf(len(tnames), zipf_s) if tnames else None
     specs: "queue.Queue[tuple]" = queue.Queue()
     for t in arrivals:
         k = kernels[int(rng.randint(len(kernels)))]
         r = int(rows_choices[int(rng.randint(len(rows_choices)))])
         op = ("ingest" if ingest_frac > 0
               and rng.uniform() < ingest_frac else "infer")
-        specs.put((t, k, r, op))
+        tn = tnames[zipf_pick(tcdf, rng)] if tnames else None
+        specs.put((t, k, r, op, tn))
     records: list[dict] = []
     rec_lock = threading.Lock()
     t0 = time.perf_counter()
@@ -437,7 +493,7 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
                 if stop is not None and stop.is_set():
                     return
                 try:
-                    t_due, k, r, op = specs.get_nowait()
+                    t_due, k, r, op, tn = specs.get_nowait()
                 except queue.Empty:
                     return
                 delay = t0 + t_due - time.perf_counter()
@@ -452,11 +508,12 @@ def run_open_loop(url: str, *, rate_rps: float, duration_s: float,
                         k, r, feed_bodies[(k, r)],
                         max_retries=max_retries,
                         retry_cap_s=retry_cap_s,
-                        path="/ingest", op="ingest")
+                        path="/ingest", op="ingest", tenant=tn)
                 else:
                     rec = client.request(k, r, bodies[(k, r)],
                                          max_retries=max_retries,
-                                         retry_cap_s=retry_cap_s)
+                                         retry_cap_s=retry_cap_s,
+                                         tenant=tn)
                 rec["t"] = round(t_due, 6)
                 with rec_lock:
                     records.append(rec)
@@ -488,6 +545,7 @@ def run_closed_loop(url: str, *, n_clients: int = 4,
                     max_retries: int = 0, retry_cap_s: float = 1.0,
                     seed: int = 0, ingest_frac: float = 0.0,
                     n_out: int = 2,
+                    tenants: int = 0, zipf_s: float = 1.1,
                     out_path: str | None = None) -> dict:
     """Saturation probe: N clients in sequential request loops for the
     duration.  Offered load equals achieved load by construction.
@@ -495,6 +553,8 @@ def run_closed_loop(url: str, *, n_clients: int = 4,
     shield_sigpipe()
     records: list[dict] = []
     rec_lock = threading.Lock()
+    tnames = tenant_names(tenants) if tenants > 0 else ()
+    tcdf = zipf_cdf(len(tnames), zipf_s) if tnames else None
     t0 = time.perf_counter()
 
     def client_loop(ci: int):
@@ -510,16 +570,18 @@ def run_closed_loop(url: str, *, n_clients: int = 4,
                 k = kernels[int(rng.randint(len(kernels)))]
                 r = int(rows_choices[int(
                     rng.randint(len(rows_choices)))])
+                tn = tnames[zipf_pick(tcdf, rng)] if tnames else None
                 if ingest_frac > 0 and rng.uniform() < ingest_frac:
                     rec = client.request(
                         k, r, feed_bodies[(k, r)],
                         max_retries=max_retries,
                         retry_cap_s=retry_cap_s,
-                        path="/ingest", op="ingest")
+                        path="/ingest", op="ingest", tenant=tn)
                 else:
                     rec = client.request(k, r, bodies[(k, r)],
                                          max_retries=max_retries,
-                                         retry_cap_s=retry_cap_s)
+                                         retry_cap_s=retry_cap_s,
+                                         tenant=tn)
                 rec["t"] = round(time.perf_counter() - t0, 6)
                 with rec_lock:
                     records.append(rec)
@@ -620,6 +682,161 @@ def run_bench_load(*, slo_ms: float = 50.0, seed: int = 7,
         obs.slo._reset_for_tests()
 
 
+def vm_rss_mb() -> float | None:
+    """Resident-set size of THIS process in MiB (Linux /proc; None
+    elsewhere) — the bounded-memory witness of the tenant bench."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as fp:
+            for line in fp:
+                if line.startswith("VmRSS:"):
+                    return round(int(line.split()[1]) / 1024.0, 1)
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def run_bench_tenant(*, n_kernels: int = 10_000, resident: int = 256,
+                     n_tenants: int = 8, zipf_s: float = 1.2,
+                     traffic_s: float = 2.0, n_threads: int = 4,
+                     hot_rate_rps: float = 50.0,
+                     seed: int = 11) -> dict:
+    """The multi-tenant hosting fold-in (docs/tenancy.md): one
+    in-process :class:`~hpnn_tpu.tenant.TenantSession` hosting
+    ``n_kernels`` kernels across ``n_tenants`` tenants with a
+    ``resident``-kernel LRU paging cap, driven by Zipf(``zipf_s``)
+    traffic — the head-heavy mix that makes paging and quotas earn
+    their keep.  Reports registration throughput at 10k scale, RSS
+    growth under the cap (the bounded-memory claim), measured cold-hit
+    paging latency (p50/p99), goodput, and the quota-shed census (the
+    hottest tenant runs with a ``hot_rate_rps`` budget so admission
+    control demonstrably bites)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    import tempfile
+
+    from hpnn_tpu.models.kernel import Kernel
+    from hpnn_tpu.tenant import TenantSession, TenantSpec
+
+    tnames = tenant_names(n_tenants)
+    specs = {tnames[0]: TenantSpec(tnames[0], "silver",
+                                   rate_rps=float(hot_rate_rps))}
+    rng = np.random.RandomState(seed)
+    n_in, hid, n_out = 6, 4, 2
+    session = None
+    page_dir = tempfile.mkdtemp(prefix="hpnn_tenant_bench_")
+    rss0 = vm_rss_mb()
+    try:
+        session = TenantSession(
+            mode="parity", fleet=True, max_wait_ms=0.5,
+            shards=16, resident_max=int(resident),
+            page_dir=page_dir, tenants=specs, page_warmup=False)
+        by_tenant: dict[str, list[str]] = {t: [] for t in tnames}
+        t_reg = time.perf_counter()
+        for j in range(int(n_kernels)):
+            k = Kernel((
+                rng.standard_normal((hid, n_in)),
+                rng.standard_normal((n_out, hid))))
+            tn = tnames[j % n_tenants]
+            kn = f"k{j}"
+            session.register_for(tn, kn, k, warmup=False)
+            by_tenant[tn].append(kn)
+        register_s = time.perf_counter() - t_reg
+        kcdf = {t: zipf_cdf(len(ks), zipf_s)
+                for t, ks in by_tenant.items()}
+        tcdf = zipf_cdf(n_tenants, zipf_s)
+        x = rng.standard_normal((2, n_in))
+        # discarded warmup: the very first dispatch pays the eager-path
+        # tracing stall and would otherwise dominate the measured p99
+        session.infer_for(tnames[-1], by_tenant[tnames[-1]][0], x)
+        counts_lock = threading.Lock()
+        counts = {"ok": 0, "shed": 0, "error": 0}
+        shed_by_tenant = {t: 0 for t in tnames}
+        errors: list[str] = []
+        lat_s: list[float] = []
+        t0 = time.perf_counter()
+
+        def tenant_loop(ti: int):
+            from hpnn_tpu.serve.batcher import QueueFull
+            trng = np.random.RandomState(seed + 100 + ti)
+            while time.perf_counter() - t0 < traffic_s:
+                tn = tnames[zipf_pick(tcdf, trng)]
+                kn = by_tenant[tn][zipf_pick(kcdf[tn], trng)]
+                t_req = time.perf_counter()
+                try:
+                    session.infer_for(tn, kn, x, timeout_s=2.0)
+                except QueueFull:  # Shed subclass: quota or queue
+                    with counts_lock:
+                        counts["shed"] += 1
+                        shed_by_tenant[tn] += 1
+                    continue
+                except Exception as exc:
+                    with counts_lock:
+                        counts["error"] += 1
+                        errors.append(repr(exc))
+                    continue
+                dt = time.perf_counter() - t_req
+                with counts_lock:
+                    counts["ok"] += 1
+                    lat_s.append(dt)
+
+        threads = [threading.Thread(target=tenant_loop, args=(ti,),
+                                    daemon=True)
+                   for ti in range(max(1, int(n_threads)))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t0
+        rss1 = vm_rss_mb()
+        pager_doc = session.pager.health_doc()
+        quota_doc = session.quota.health_doc()
+        cap_ok = (pager_doc["resident"] <= int(resident))
+        rss_growth = (round(rss1 - rss0, 1)
+                      if rss0 is not None and rss1 is not None
+                      else None)
+        return {
+            "metric": "tenant_host",
+            "n_kernels": int(n_kernels),
+            "n_tenants": int(n_tenants),
+            "zipf_s": float(zipf_s),
+            "resident_cap": int(resident),
+            "register_s": round(register_s, 3),
+            "register_krps": round(n_kernels / register_s / 1e3, 2),
+            "rss_before_mb": rss0,
+            "rss_after_mb": rss1,
+            "rss_growth_mb": rss_growth,
+            "resident": pager_doc["resident"],
+            "paged": pager_doc["paged"],
+            "resident_cap_ok": bool(cap_ok),
+            "page_ins": pager_doc["page_ins"],
+            "page_outs": pager_doc["page_outs"],
+            "cold_p50_ms": pager_doc["cold_p50_ms"],
+            "cold_p99_ms": pager_doc["cold_p99_ms"],
+            "requests": sum(counts.values()),
+            "ok": counts["ok"],
+            "shed": counts["shed"],
+            "errors": counts["error"],
+            "error_sample": errors[:4],
+            "goodput_rps": round(counts["ok"] / wall_s, 1)
+                           if wall_s else 0.0,
+            "p99_ms": (percentile_ms(lat_s, 99) if lat_s else None),
+            "hot_tenant": tnames[0],
+            "hot_rate_rps": float(hot_rate_rps),
+            "quota_shed": shed_by_tenant[tnames[0]],
+            "shed_by_tenant": {t: n for t, n in
+                               sorted(shed_by_tenant.items()) if n},
+            "tenants": quota_doc,
+        }
+    finally:
+        if session is not None:
+            session.close()
+        import shutil
+
+        shutil.rmtree(page_dir, ignore_errors=True)
+
+
 # ------------------------------------------------------------ main
 
 
@@ -656,6 +873,11 @@ def main(argv=None) -> int:
                          "needs an online_nn server)")
     ap.add_argument("--n-out", type=int, default=2,
                     help="target width of --mix ingest samples")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="spread requests over N synthetic tenants "
+                         "(t000..) via the X-Tenant header")
+    ap.add_argument("--zipf", type=float, default=1.1, metavar="S",
+                    help="Zipf skew of the tenant draw (--tenants)")
     ap.add_argument("--timeout", type=float, default=2.0,
                     help="per-request timeout_s")
     ap.add_argument("--retries", type=int, default=2,
@@ -677,11 +899,14 @@ def main(argv=None) -> int:
     rows = tuple(int(s) for s in args.rows.split(",") if s)
     if not 0.0 <= args.mix <= 1.0:
         ap.error("--mix must be in [0, 1]")
+    if args.tenants < 0:
+        ap.error("--tenants must be >= 0")
     common = dict(kernels=kernels, rows_choices=rows,
                   n_in=args.n_in, timeout_s=args.timeout,
                   max_retries=args.retries,
                   retry_cap_s=args.retry_cap, seed=args.seed,
                   ingest_frac=args.mix, n_out=args.n_out,
+                  tenants=args.tenants, zipf_s=args.zipf,
                   out_path=args.out)
     if args.closed:
         summary = run_closed_loop(args.url, n_clients=args.clients,
