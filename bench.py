@@ -829,6 +829,107 @@ def bench_drift_overhead(iters: int = 200, repeats: int = 5):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_meter_overhead(iters: int = 300, repeats: int = 7,
+                         tenants: int = 4):
+    """Paired measurement of the metering plane's MARGINAL cost on
+    the serve hot path it taps: the same round-robin
+    ``Session.infer`` loop over ``tenants`` tenant-scoped kernels
+    with the JSONL sink armed in BOTH legs, plus — in the "on" leg
+    only — ``HPNN_METER=1`` (every dispatch folded into the
+    space-saving sketches, throttled ``meter.sketch`` emissions).
+    Quantifies the claim that armed metering is affordable on the
+    hot path (docs/observability.md "Tenant metering";
+    tools/bench_gate.py gates ``meter_overhead_pct``)."""
+    from hpnn_tpu import obs, serve
+    from hpnn_tpu.models import kernel as kernel_mod
+
+    prev_sink = obs.sink_path() if obs.enabled() else None
+    d = tempfile.mkdtemp(prefix="hpnn_meter_bench_")
+    saved = {k: os.environ.pop(k, None)
+             for k in ("HPNN_METER", "HPNN_METER_TOPK")}
+
+    def arm(on: bool, sink: str) -> None:
+        # the meter memo caches the armed config, so each leg resets
+        # it through the programmatic twin
+        if on:
+            os.environ["HPNN_METER"] = "1"
+        else:
+            os.environ.pop("HPNN_METER", None)
+        obs.meter._reset_for_tests()
+        obs.configure(sink)
+
+    n_in, n_hid, n_out = FLEET_SHAPE
+    kern = kernel_mod.generate(4243, n_in, [n_hid], n_out)[0]
+    rng = np.random.RandomState(3)
+    Xs = rng.normal(size=(iters, n_in))
+    names = [f"t{j}:bench" for j in range(tenants)]
+    sess = None
+    try:
+        sess = serve.Session(max_batch=8, n_buckets=2,
+                             max_wait_ms=0.5)
+        for name in names:
+            sess.register_kernel(name, kern)
+
+        def leg() -> None:
+            for i in range(iters):
+                sess.infer(names[i % tenants], Xs[i])
+
+        # warm both legs (compile, sink open, meter memo)
+        arm(False, os.path.join(d, "warm_off.jsonl"))
+        leg()
+        arm(True, os.path.join(d, "warm_on.jsonl"))
+        leg()
+
+        on_s, off_s = [], []
+        for r in range(repeats):
+            arm(False, os.path.join(d, f"off{r}.jsonl"))
+            t0 = time.perf_counter()
+            leg()
+            off_s.append(time.perf_counter() - t0)
+            arm(True, os.path.join(d, f"on{r}.jsonl"))
+            t0 = time.perf_counter()
+            leg()
+            on_s.append(time.perf_counter() - t0)
+            obs.meter.emit_sketch()  # unthrottled proof, outside the
+            # timed region
+        obs.configure(None)  # close the last sink so the scan below
+        # is over flushed bytes
+
+        # the proof the "on" leg actually metered: the last on-leg
+        # sink must carry meter.sketch records
+        sketches = 0
+        with open(os.path.join(d, f"on{repeats - 1}.jsonl")) as fp:
+            for ln in fp:
+                sketches += '"meter.sketch"' in ln
+        deltas = [round(100.0 * (a - b) / b, 2)
+                  for a, b in zip(on_s, off_s)]
+        return {
+            "iters": iters,
+            "tenants": tenants,
+            "loop_s_meter_off": _stats([round(v, 4) for v in off_s]),
+            "loop_s_meter_on": _stats([round(v, 4) for v in on_s]),
+            "paired_overhead_pct": {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            },
+            "meter_sketches_last_round": sketches,
+        }
+    finally:
+        if sess is not None:
+            sess.close()
+        obs.configure(None)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        from hpnn_tpu.obs import meter as _meter_mod
+
+        _meter_mod._reset_for_tests()
+        obs.configure(prev_sink)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 FLEET_MEMBERS = 64
 FLEET_SHAPE = (32, 16, 4)   # HPNN-sized: the paper's natural workload
 FLEET_TICKS = 30
@@ -1227,6 +1328,16 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["drift_overhead_error"] = repr(exc)
 
+    # meter-sketch overhead: the same paired shape on the SERVE hot
+    # path over tenant-scoped kernels, HPNN_METER=1 in one leg
+    # (docs/observability.md "Tenant metering") — rides the same skip
+    # knob, best-effort
+    if not os.environ.get("HPNN_BENCH_NO_OBS_OVERHEAD"):
+        try:
+            out["meter_overhead"] = bench_meter_overhead()
+        except Exception as exc:
+            out["meter_overhead_error"] = repr(exc)
+
     # HPNN_METRICS: the bench subprocesses/rounds inherit the knob, so
     # the run's structured events land in the sink — record where, and
     # fold obs_report's machine summary in (best-effort: a torn sink
@@ -1482,6 +1593,23 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["quota_drill_error"] = repr(exc)
 
+    # Hog drill (tools/chaos_drill.py run_bench_hog_drill): one tenant
+    # offers 20x the zipf head's rate under an armed meter — prove the
+    # fleet-merged top-K names the hog within a bounded window,
+    # tenant_report blames it for the majority of device-seconds, the
+    # shed-rate alert fires, and the capsule carries meter.json
+    # (docs/observability.md "Tenant metering").  Rides the same
+    # HPNN_BENCH_NO_DRILL knob (in-process, a few seconds).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["hog_drill"] = chaos_drill.run_bench_hog_drill()
+        except Exception as exc:
+            out["hog_drill_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -1621,6 +1749,12 @@ def main(argv=None) -> None:
             qd["victim_goodput_ratio"])
         compact["drill_quota_offender_shed"] = qd["offender_shed"]
         compact["drill_quota_alert_fired"] = qd["alert_fired"]
+    if ("hog_drill" in out
+            and out["hog_drill"].get("blame_pct") is not None):
+        hd = out["hog_drill"]
+        compact["drill_hog_blame_pct"] = hd["blame_pct"]
+        compact["drill_hog_detect_s"] = hd["detect_s"]
+        compact["drill_hog_alert_fired"] = hd["alert_fired"]
     if ("autoscale" in out
             and out["autoscale"].get("goodput_x") is not None):
         asc = out["autoscale"]
@@ -1643,6 +1777,10 @@ def main(argv=None) -> None:
     if "drift_overhead" in out:
         compact["drift_overhead_pct"] = (
             out["drift_overhead"]["paired_overhead_pct"]["median"]
+        )
+    if "meter_overhead" in out:
+        compact["meter_overhead_pct"] = (
+            out["meter_overhead"]["paired_overhead_pct"]["median"]
         )
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:

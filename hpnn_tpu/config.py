@@ -409,6 +409,13 @@ KNOBS = {
     "HPNN_DRIFT_Z": {
         "default": 3.0, "doc": "docs/observability.md",
         "desc": "decay-sentinel EWMA z-score breach threshold"},
+    # --- tenant metering (docs/observability.md) ---
+    "HPNN_METER": {
+        "default": None, "doc": "docs/observability.md",
+        "desc": "arm per-tenant resource metering (sketches + governor)"},
+    "HPNN_METER_TOPK": {
+        "default": 32, "doc": "docs/observability.md",
+        "desc": "full-resolution tenants per axis; rest -> _other"},
     # --- chaos / durability (docs/resilience.md) ---
     "HPNN_CHAOS": {
         "default": None, "doc": "docs/resilience.md",

@@ -70,7 +70,15 @@ structured side channel next to it:
   sentinel over the resident kernel's eval loss — normalized
   ``drift.score`` gauges, ``online.drift`` events, and a
   ``drift.json`` capsule artifact — ``HPNN_DRIFT``
-  (obs/drift.py; drill: ``tools/chaos_drill.py --drill drift``).
+  (obs/drift.py; drill: ``tools/chaos_drill.py --drill drift``);
+* per-tenant cost attribution with a cardinality governor:
+  mergeable space-saving sketches over device seconds / FLOPs /
+  bytes / queue seconds / rows / sheds, top-K + ``_other`` export
+  on ``/metrics`` and ``/meterz``, fleet merge through the
+  collector, and a ``meter.json`` capsule artifact —
+  ``HPNN_METER`` / ``HPNN_METER_TOPK`` (obs/meter.py; blame table:
+  ``tools/tenant_report.py``; drill: ``tools/chaos_drill.py
+  --drill hog``).
 
 Typical instrumentation site::
 
@@ -89,8 +97,8 @@ docs/analysis.md.
 
 from hpnn_tpu.obs import (alerts, collector, cost, device, drift,
                           export, flight, forensics, ledger,
-                          lockwatch, probes, propagate, slo, spans,
-                          triggers)
+                          lockwatch, meter, probes, propagate, slo,
+                          spans, triggers)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
 from hpnn_tpu.obs.registry import (
     ENV_KNOB,
@@ -129,6 +137,7 @@ __all__ = [
     "gauge",
     "ledger",
     "lockwatch",
+    "meter",
     "observe",
     "probes",
     "propagate",

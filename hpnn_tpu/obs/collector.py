@@ -24,7 +24,12 @@ both halves of the missing hop:
   and merged log2 buckets, so fleet p99 comes out of
   ``export._quantile_estimate`` over the union — served on its own
   ``GET /metrics`` (Prometheus) and ``GET /fleetz`` (JSON: per-worker
-  health/staleness + fleet totals).  It can additionally **scrape**
+  health/staleness + fleet totals).  Workers' ``meter.sketch``
+  records (obs/meter.py, per-tenant resource sketches) merge the
+  same way — latest per worker, space-saving merge per axis — into
+  fleet ``hpnn_meter_*`` families on ``/metrics`` and a
+  ``GET /meterz`` tenant census, so the fleet-wide top-K hog is
+  computable centrally.  It can additionally **scrape**
   worker ``/metrics`` endpoints (``--scrape URL``) for liveness when
   workers cannot push.  With ``HPNN_CAPSULE_DIR`` armed it also
   answers ``POST /v1/capture`` — a manual forensic capsule of the
@@ -54,7 +59,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.request import Request, urlopen
 
-from hpnn_tpu.obs import export, registry
+from hpnn_tpu.obs import export, meter, registry
 
 ENV_URL = "HPNN_COLLECTOR"
 ENV_QUEUE = "HPNN_COLLECTOR_QUEUE"
@@ -286,7 +291,7 @@ class Collector:
             if w is None:
                 w = self.workers[key] = {
                     "pid": pid, "rank": rank, "records": 0,
-                    "last_push": now, "summary": None,
+                    "last_push": now, "summary": None, "meter": None,
                 }
             w["records"] += len(parsed)
             w["last_push"] = now
@@ -295,6 +300,8 @@ class Collector:
             for rec in parsed:
                 if rec.get("ev") == "obs.summary":
                     w["summary"] = rec  # latest wins
+                elif rec.get("ev") == "meter.sketch":
+                    w["meter"] = rec  # latest wins (cumulative)
         if self._fp is not None:
             with self._lock:
                 for rec in parsed:
@@ -386,10 +393,31 @@ class Collector:
             doc["scrape"] = scrapes
         return doc
 
+    def meterz(self) -> dict | None:
+        """The fleet ``/meterz`` census: workers' latest
+        ``meter.sketch`` records merged per axis (totals add, entries
+        sum, top-K + ``_other`` re-governed over the union) — the
+        fleet-wide tenant blame view.  None when no worker has pushed
+        a sketch (meter unarmed fleet-wide)."""
+        with self._lock:
+            docs = [w["meter"] for w in self.workers.values()
+                    if w.get("meter")]
+        if not docs:
+            return None
+        doc = meter.merge_sketch_docs(docs)
+        doc["status"] = "ok"
+        doc["workers"] = len(docs)
+        return doc
+
     def metrics_body(self) -> bytes:
         """Fleet ``/metrics``: the merged snapshot rendered with the
-        standard exposition renderer, plus collector-level totals."""
-        body = export.render_prometheus(self._merged_snapshot())
+        standard exposition renderer, plus the fleet-merged meter
+        families and collector-level totals."""
+        body = export.render_prometheus(self._merged_snapshot(),
+                                        local_meter=False)
+        mdoc = self.meterz()
+        meter_lines = ([] if mdoc is None else export.render_meter_lines(
+            {ax: d["top"] for ax, d in mdoc["axes"].items()}))
         with self._lock:
             n_workers = len(self.workers)
             stale = max(
@@ -405,6 +433,7 @@ class Collector:
                 "# TYPE hpnn_fleet_max_staleness_seconds gauge",
                 f"hpnn_fleet_max_staleness_seconds {stale:.3f}",
             ]
+        extra = meter_lines + extra
         return body.encode("utf-8") + ("\n".join(extra) + "\n").encode()
 
     def healthz(self) -> dict:
@@ -518,6 +547,12 @@ class _CollectorHandler(BaseHTTPRequestHandler):
                        "text/plain; version=0.0.4; charset=utf-8")
         elif self.path == "/fleetz":
             self._send_json(200, self.collector.fleetz())
+        elif self.path == "/meterz":
+            doc = self.collector.meterz()
+            if doc is None:
+                self._send_json(404, {"error": "no meter sketches"})
+            else:
+                self._send_json(200, doc)
         elif self.path == "/healthz":
             self._send_json(200, self.collector.healthz())
         else:

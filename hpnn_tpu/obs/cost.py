@@ -225,6 +225,15 @@ def analyze_fn(name: str, fn, *args, units: int = 1, **meta) -> None:
     analyze_jitted(name, jitted, *args, units=units, **meta)
 
 
+def lookup(name: str) -> dict | None:
+    """The cataloged static cost entry for one executable name —
+    ``{"flops", "bytes", "units"}`` (costs None when analysis was
+    unavailable) or None when uncataloged.  The per-tenant meter's
+    dispatch join point (obs/meter.py): lock-free, entries are never
+    removed outside test resets."""
+    return _catalog.get(name)
+
+
 def record_dispatch(name: str, dt: float,
                     units: int | None = None) -> None:
     """Combine one measured dispatch wall time with the cataloged

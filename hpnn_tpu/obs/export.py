@@ -162,10 +162,53 @@ def _quantile_estimate(agg: dict, q: float) -> float:
     return float(vmax) if vmax is not None else 0.0
 
 
-def render_prometheus(snap: dict | None) -> str:
+# meter axis -> exported metric family (obs/meter.py).  Every family
+# is a counter: the sketches accumulate monotonically over a process
+# lifetime, and the governed export per family carries at most K+1
+# distinct ``tenant=`` labels by construction.
+METER_FAMILIES = {
+    "device_s": "hpnn_meter_device_seconds",
+    "flops": "hpnn_meter_flops",
+    "bytes": "hpnn_meter_bytes",
+    "queue_s": "hpnn_meter_queue_seconds",
+    "rows": "hpnn_meter_rows",
+    "sheds": "hpnn_meter_sheds",
+}
+
+
+def render_meter_lines(doc: dict | None,
+                       openmetrics: bool = False) -> list[str]:
+    """Exposition lines for one governed meter export document —
+    ``{axis: {tenant: value, ..., "_other": rest}}``, from
+    ``meter.export_doc()`` locally or the collector's fleet merge
+    (``axes[ax]["top"]`` there).  Empty list when the meter is
+    unarmed (doc None) — an unarmed scrape stays meter-silent."""
+    if not doc:
+        return []
+    lines = []
+    for axis, tenants in sorted(doc.items()):
+        fam = METER_FAMILIES.get(axis)
+        if fam is None or not tenants:
+            continue
+        # 0.0.4 names the suffixed metric in TYPE; OpenMetrics names
+        # the family and suffixes the sample — same split as the
+        # counter loops in the snapshot renderers
+        tname = fam if openmetrics else fam + "_total"
+        lines.append(f"# TYPE {tname} counter")
+        for tenant, v in sorted(tenants.items()):
+            labels = _render_labels({"tenant": tenant})
+            lines.append(f"{fam}_total{labels} {_fmt(v)}")
+    return lines
+
+
+def render_prometheus(snap: dict | None, *,
+                      local_meter: bool = True) -> str:
     """The Prometheus text exposition (0.0.4) of one registry
     snapshot.  ``snap=None`` (registry inactive) renders a comment-only
-    document — a scrape of an idle process is 200, not an error."""
+    document — a scrape of an idle process is 200, not an error.
+    ``local_meter=False`` omits this process's governed meter families
+    — the collector renders a *foreign* merged snapshot and appends
+    its own fleet-merged meter lines instead (obs/collector.py)."""
     lines = []
     if snap is None:
         lines.append("# hpnn obs registry inactive "
@@ -190,10 +233,15 @@ def render_prometheus(snap: dict | None) -> str:
             lines.append(f"{m}{labels} {_fmt(est)}")
         lines.append(f"{m}_sum {_fmt(agg['total'])}")
         lines.append(f"{m}_count {agg['n']}")
+    if local_meter:
+        from hpnn_tpu.obs import meter
+
+        lines.extend(render_meter_lines(meter.export_doc()))
     return "\n".join(lines) + "\n"
 
 
-def render_openmetrics(snap: dict | None) -> str:
+def render_openmetrics(snap: dict | None, *,
+                       local_meter: bool = True) -> str:
     """The OpenMetrics 1.0 text exposition of one registry snapshot —
     the variant negotiated by ``Accept: application/openmetrics-text``.
     Aggregates render as **histograms** with cumulative ``le`` buckets
@@ -236,6 +284,11 @@ def render_openmetrics(snap: dict | None) -> str:
         lines.append(f'{m}_bucket{{le="+Inf"}} {agg["n"]}')
         lines.append(f"{m}_sum {_fmt(agg['total'])}")
         lines.append(f"{m}_count {agg['n']}")
+    if local_meter:
+        from hpnn_tpu.obs import meter
+
+        lines.extend(render_meter_lines(meter.export_doc(),
+                                        openmetrics=True))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 

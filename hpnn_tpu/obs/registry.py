@@ -235,7 +235,8 @@ def _init():
                     or os.environ.get("HPNN_ALERTS")
                     or os.environ.get("HPNN_SAMPLE")
                     or os.environ.get("HPNN_CAPSULE_DIR")
-                    or os.environ.get("HPNN_DRIFT")):
+                    or os.environ.get("HPNN_DRIFT")
+                    or os.environ.get("HPNN_METER")):
                 _state = False
                 return False
             path = None
@@ -508,6 +509,13 @@ def _crash_flush(ev: str, detail: str, reason: str) -> None:
         if not isinstance(_state, _State):
             return
         event(ev, reason=detail)
+        # the meter's final cumulative sketch — a worker dying inside
+        # its first emission interval would otherwise never land one
+        # record and be invisible to the fleet blame table (lazy
+        # import: meter imports registry)
+        from hpnn_tpu.obs import meter
+
+        meter.emit_sketch()
         summary()
         flush()
         flight.dump(reason)
@@ -560,6 +568,9 @@ def _at_exit() -> None:
     st = _state
     if isinstance(st, _State):
         try:
+            from hpnn_tpu.obs import meter
+
+            meter.emit_sketch()   # final cumulative sketch (no-op unarmed)
             summary()
             if st.fp is not None:
                 st.fp.close()
@@ -596,7 +607,7 @@ def _reset_for_tests() -> None:
                  "hpnn_tpu.obs.propagate", "hpnn_tpu.obs.collector",
                  "hpnn_tpu.obs.alerts", "hpnn_tpu.obs.lockwatch",
                  "hpnn_tpu.obs.forensics", "hpnn_tpu.obs.triggers",
-                 "hpnn_tpu.obs.drift",
+                 "hpnn_tpu.obs.drift", "hpnn_tpu.obs.meter",
                  "hpnn_tpu.chaos", "hpnn_tpu.online.wal"):
         mod = sys.modules.get(name)
         if mod is not None:

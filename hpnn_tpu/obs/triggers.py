@@ -207,7 +207,7 @@ def _assemble(path: str, reason: str, detail: dict | None,
                 flight_path = None
                 errors.append(f"flight.jsonl: {exc}")
 
-        from hpnn_tpu.obs import drift, export, forensics
+        from hpnn_tpu.obs import drift, export, forensics, meter
 
         spans = forensics.recent_spans()
         _write("spans.jsonl",
@@ -224,6 +224,13 @@ def _assemble(path: str, reason: str, detail: dict | None,
             # absent when HPNN_DRIFT is unarmed)
             _write("drift.json",
                    json.dumps(sketches, indent=1, default=str))
+        attribution = meter.sketch_doc()
+        if attribution is not None:
+            # who was spending what when it fired: per-tenant resource
+            # sketches + the governed top-K export (obs/meter.py;
+            # absent when HPNN_METER is unarmed)
+            _write("meter.json",
+                   json.dumps(attribution, indent=1, default=str))
 
         profile = _profile_window(os.path.join(path, "profile"),
                                   cfg.get("profile_ms", 0.0))

@@ -335,9 +335,18 @@ class Batcher:
         if not batch:
             return 0
         now = self._clock()
-        obs.observe("serve.wait_ms",
-                    [(now - r.submitted) * 1e3 for r in batch],
+        waits = [now - r.submitted for r in batch]
+        obs.observe("serve.wait_ms", [w * 1e3 for w in waits],
                     batcher=self.name)
+        if obs.meter.enabled():
+            # attribute queue-wait to the owning tenant: fleet-mode
+            # payloads carry their kernel name (serve/server.py), a
+            # per-kernel batcher is named for its kernel
+            for r, w in zip(batch, waits):
+                p = r.payload
+                owner = (p[0] if isinstance(p, tuple) and p
+                         and isinstance(p[0], str) else self.name)
+                obs.meter.note_queue(owner, w)
         obs.observe("serve.batch_size", [sum(r.rows for r in batch)],
                     batcher=self.name, requests=len(batch))
         # the dispatch span parents to the oldest request's root span —

@@ -538,13 +538,15 @@ class Engine:
                 block[:n] = rows[start:start + n]
             else:
                 block = rows[start:start + n]
-            if obs.cost.enabled():
+            if obs.cost.enabled() or obs.meter.enabled():
                 t0 = time.perf_counter()
                 res = np.asarray(fn(block))
+                dt = time.perf_counter() - t0
                 # padding does the full bucket's work, so the cataloged
-                # (per-bucket) cost applies unscaled
-                obs.cost.record_dispatch(
-                    exe_name, time.perf_counter() - t0)
+                # (per-bucket) cost applies unscaled — to the perf
+                # gauges and to the owning tenant's meter alike
+                obs.cost.record_dispatch(exe_name, dt)
+                obs.meter.note_dispatch(entry.name, dt, exe=exe_name)
             else:
                 res = np.asarray(fn(block))
             out[start:start + n] = res[:n]
@@ -726,23 +728,45 @@ class Engine:
                 if self.mode == "parity":
                     blocks = [rows_for[m].astype(dtype, copy=False)
                               for m in members]
-                    outs = fn(blocks)
+                    if obs.meter.enabled():
+                        t0 = time.perf_counter()
+                        outs = fn(blocks)
+                        dt = time.perf_counter() - t0
+                        # parity blocks are unpadded, so each member's
+                        # true row count is known: split wall time
+                        # row-proportionally (the padded path below
+                        # splits evenly — every member costs a full
+                        # bucket there)
+                        total = sum(b.shape[0] for b in blocks) or 1
+                        for m, b in zip(members, blocks):
+                            obs.meter.note_dispatch(
+                                m, dt * b.shape[0] / total,
+                                rows=b.shape[0])
+                    else:
+                        outs = fn(blocks)
                 else:
                     stackb = np.zeros(
                         (n, bucket, ents[0].n_inputs), dtype=dtype)
                     for j, m in enumerate(members):
                         r = rows_for[m]
                         stackb[j, :r.shape[0]] = r
-                    if obs.cost.enabled():
+                    if obs.cost.enabled() or obs.meter.enabled():
                         t0 = time.perf_counter()
                         res = np.asarray(fn(stackb))
-                        obs.cost.record_dispatch(
-                            self._fleet_exe_name(
-                                (("fleet",)
-                                 + tuple((e.name, e.version)
-                                         for e in ents),
-                                 bucket, dtype.str)),
-                            time.perf_counter() - t0)
+                        dt = time.perf_counter() - t0
+                        exe = self._fleet_exe_name(
+                            (("fleet",)
+                             + tuple((e.name, e.version)
+                                     for e in ents),
+                             bucket, dtype.str))
+                        obs.cost.record_dispatch(exe, dt)
+                        # one executable ran every member's bucket:
+                        # split wall time evenly, scale the cataloged
+                        # (n*bucket-unit) cost to each member's bucket
+                        for m in members:
+                            obs.meter.note_dispatch(m, dt / n,
+                                                    rows=bucket,
+                                                    exe=exe)
                     else:
                         res = np.asarray(fn(stackb))
                     outs = [res[j, :rows_for[m].shape[0]]

@@ -514,6 +514,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(404, {"error": "tenancy not enabled"})
             else:
                 self._reply(200, tenant_doc())
+        elif self.path == "/meterz":
+            # per-tenant resource census (obs/meter.py): governed
+            # top-K + _other per axis; 404 when HPNN_METER is unarmed
+            doc = obs.meter.meterz_doc()
+            if doc is None:
+                self._reply(404, {"error": "meter not armed"})
+            else:
+                self._reply(200, doc)
         elif self.path == "/metrics":
             body, ctype = obs.export.metrics_response(
                 self.headers.get("Accept"))

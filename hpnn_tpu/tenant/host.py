@@ -129,6 +129,9 @@ class TenantSession(Session):
         finally:
             self.quota.release(tenant)
         self.quota.record(tenant, self._clock() - t0)
+        shape = getattr(x, "shape", None)
+        obs.meter.note_request(
+            tenant, shape[0] if shape and len(shape) == 2 else 1)
         return out
 
     # ------------------------------------------------------------ health

@@ -30,7 +30,14 @@ Every outcome lands in a per-tenant rolling window, published as the
 ``tenant.p99_ms`` / ``tenant.shed_rate`` / ``tenant.inflight``
 gauges — the per-tenant surface the ``HPNN_ALERTS`` grammar watches
 (a rule on ``tenant.shed_rate`` fires on whichever tenant breaches;
-the record's ``tenant`` field names it).  stdlib only.
+the record's ``tenant`` field names it).  The gauge ``tenant=``
+labels route through the cardinality governor
+(``obs.meter.tenant_label``): top-K tenants keep their names, the
+long tail exports as ``tenant="_other"`` — so a 10k-tenant fleet
+publishes O(K) series, not 30k (docs/observability.md, "Tenant
+metering").  The shed *count* events keep the real tenant name:
+they are bounded by traffic, not by tenant census, and the alert →
+capsule path needs the offender named.  stdlib only.
 """
 
 from __future__ import annotations
@@ -230,8 +237,9 @@ class QuotaEnforcer:
                 shed_rate = self._shed_rate(st, now)
         if over is None:
             obs.gauge("tenant.inflight", float(inflight),
-                      tenant=tenant)
+                      tenant=obs.meter.tenant_label(tenant))
             return
+        obs.meter.note_shed(tenant)
         fields = {"reason": "quota", "tenant": tenant, "over": over}
         if kernel is not None:
             fields["kernel"] = kernel
@@ -239,9 +247,10 @@ class QuotaEnforcer:
         obs.count("tenant.shed", tenant=tenant, over=over)
         # the alertable per-tenant breach signal (docs/tenancy.md):
         # published on the shed edge so a quota storm cannot hide
-        # behind the publish stride
-        obs.gauge("tenant.shed_rate", shed_rate, tenant=tenant,
-                  over=over)
+        # behind the publish stride.  The label is governed; the
+        # serve.shed/tenant.shed counts above carry the real name.
+        obs.gauge("tenant.shed_rate", shed_rate,
+                  tenant=obs.meter.tenant_label(tenant), over=over)
         raise QuotaExceeded(
             f"tenant {tenant!r} over {over} quota; retry later",
             tenant=tenant, retry_after_s=retry_s or 1.0)
@@ -280,9 +289,10 @@ class QuotaEnforcer:
             shed_rate = self._shed_rate(st, now)
             spec = st.spec
         p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
-        obs.gauge("tenant.p99_ms", p99, tenant=tenant,
+        label = obs.meter.tenant_label(tenant)
+        obs.gauge("tenant.p99_ms", p99, tenant=label,
                   slo_class=spec.slo_class, target_ms=spec.target_ms)
-        obs.gauge("tenant.shed_rate", shed_rate, tenant=tenant)
+        obs.gauge("tenant.shed_rate", shed_rate, tenant=label)
 
     # ------------------------------------------------------------ health
     def p99_ms(self, tenant: str) -> float | None:

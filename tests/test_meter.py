@@ -1,0 +1,399 @@
+"""Tenant metering plane (obs/meter.py): space-saving attribution
+sketches, the cardinality governor, the fleet merge, and the blame
+table (tools/tenant_report.py).
+
+The plane's contract is the usual obs one — ``HPNN_METER`` unset ⇒
+constant-time no-ops — plus its own: exported per-tenant values are
+space-saving **lower bounds** whose sum conserves the exact axis
+total (the ``_other`` remainder absorbs the difference); the merge
+rule is commutative and associative so worker order never matters;
+and *no* metric family ever carries more than K+1 distinct
+``tenant=`` labels, no matter how many tenants exist."""
+
+import importlib.util
+import itertools
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import export, meter, triggers
+from hpnn_tpu.tenant.quota import QuotaEnforcer, QuotaExceeded, TenantSpec
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _read(path):
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _arm(monkeypatch, tmp_path, k=None):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.setenv("HPNN_METER", "1")
+    if k is not None:
+        monkeypatch.setenv("HPNN_METER_TOPK", str(k))
+    obs._reset_for_tests()
+    return sink
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# ------------------------------------------------------------ unarmed
+def test_unarmed_everything_noops(monkeypatch, tmp_path):
+    sink = tmp_path / "m.jsonl"
+    monkeypatch.setenv("HPNN_METRICS", str(sink))
+    monkeypatch.delenv("HPNN_METER", raising=False)
+    obs._reset_for_tests()
+    assert not meter.enabled()
+    meter.note_dispatch("t:k", 0.5)
+    meter.note_queue("t:k", 0.1)
+    meter.note_request("t", 8)
+    meter.note_shed("t")
+    meter.emit_sketch()
+    assert meter.export_doc() is None
+    assert meter.sketch_doc() is None
+    assert meter.meterz_doc() is None
+    assert meter.health_doc() == {"armed": False}
+    assert export.render_meter_lines(meter.export_doc()) == []
+    obs.flush()
+    if os.path.exists(sink):
+        assert not [r for r in _read(sink)
+                    if r.get("ev") == "meter.sketch"]
+
+
+def test_unarmed_governor_still_bounds_gauge_labels(monkeypatch,
+                                                    tmp_path):
+    """The PR-17 cardinality fix must not depend on the knob: unarmed,
+    a first-K-distinct admission set keeps per-tenant gauge labels
+    O(K) — and the admitted set is stable on re-query."""
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.delenv("HPNN_METER", raising=False)
+    obs._reset_for_tests()
+    labels = [meter.tenant_label(f"t{i:03d}") for i in range(100)]
+    named = [l for l in labels if l != meter.OTHER]
+    assert len(named) == meter.DEFAULT_TOPK
+    assert labels[:meter.DEFAULT_TOPK] == \
+        [f"t{i:03d}" for i in range(meter.DEFAULT_TOPK)]
+    assert set(labels[meter.DEFAULT_TOPK:]) == {meter.OTHER}
+    # admitted names stay admitted; the tail stays _other
+    assert meter.tenant_label("t000") == "t000"
+    assert meter.tenant_label("t099") == meter.OTHER
+
+
+# ------------------------------------------------------------- sketch
+def test_space_saving_eviction_lower_bound_and_conservation():
+    """The Metwally invariants directly: an evicted entry's count is
+    inherited as the newcomer's err, export values are ``count - err``
+    lower bounds, and every export sums to the exact total."""
+    sk = meter._SpaceSaving(2)
+    sk.add("a", 5.0)
+    sk.add("b", 3.0)
+    sk.add("c", 1.0)              # evicts b (min count); c inherits 3
+    assert sk.total == 9.0
+    assert sk.entries["c"] == [4.0, 3.0]
+    exp = sk.export(2)
+    assert exp["a"] == 5.0
+    assert exp["c"] == 1.0        # lower bound, not the inflated count
+    assert exp[meter.OTHER] == pytest.approx(3.0)
+    assert sum(exp.values()) == pytest.approx(sk.total)
+
+
+def _mk(weights, cap=1024):
+    sk = meter._SpaceSaving(cap)
+    for t, w in weights:
+        sk.add(t, w)
+    return sk
+
+
+def test_merge_commutative_and_associative():
+    a = _mk([("x", 5.0), ("y", 2.0), ("z", 1.0)])
+    b = _mk([("y", 7.0), ("w", 3.0)])
+    c = _mk([("x", 1.0), ("w", 1.0), ("q", 4.0)])
+    ab = a.merge(b).to_doc()
+    ba = b.merge(a).to_doc()
+    assert ab == ba
+    left = a.merge(b).merge(c).to_doc()
+    right = a.merge(b.merge(c)).to_doc()
+    assert left == right
+    assert left["total"] == pytest.approx(24.0)
+    assert left["entries"]["y"] == [9.0, 0.0]
+
+
+def test_merge_sketch_docs_order_independent():
+    docs = []
+    for i, weights in enumerate(([("x", 5.0), ("y", 2.0)],
+                                 [("y", 7.0), ("w", 3.0)],
+                                 [("x", 1.0), ("q", 4.0)])):
+        docs.append({"k": 4, "tenants_seen": 2 + i,
+                     "axes": {"device_s": _mk(weights).to_doc(),
+                              "rows": _mk([("x", float(i + 1))]).to_doc()}})
+    views = [meter.merge_sketch_docs(list(p))
+             for p in itertools.permutations(docs)]
+    assert all(v == views[0] for v in views[1:])
+    dev = views[0]["axes"]["device_s"]
+    assert dev["total"] == pytest.approx(22.0)
+    assert dev["top"]["y"] == pytest.approx(9.0)
+    assert views[0]["tenants_seen"] == 4      # max across workers
+
+
+def test_merged_topk_superset_of_true_topk_zipf():
+    """Four workers each sketch a slice of a zipf-headed population
+    with truncating caps (evictions do happen); the fleet merge's
+    top-K must still contain every true top-K tenant."""
+    k, n_head, n_tail = 8, 8, 192
+    head = [(f"h{i}", 100.0 / (i + 1)) for i in range(n_head)]
+    tail = [(f"t{i:03d}", 1.0) for i in range(n_tail)]
+    rng = np.random.RandomState(0)
+    docs = []
+    for w in range(4):
+        weights = [(t, v / 4.0) for t, v in head + tail]
+        rng.shuffle(weights)      # per-worker arrival order differs
+        docs.append({"k": k, "axes":
+                     {"device_s": _mk(weights, cap=64).to_doc()}})
+    merged = meter.merge_sketch_docs(docs)
+    named = set(merged["axes"]["device_s"]["top"]) - {meter.OTHER}
+    assert {t for t, _ in head} <= named
+    total = merged["axes"]["device_s"]["total"]
+    assert total == pytest.approx(sum(v for _, v in head + tail))
+    assert sum(merged["axes"]["device_s"]["top"].values()) == \
+        pytest.approx(total)      # conservation survives the merge
+
+
+def test_other_conservation_through_the_armed_module(monkeypatch,
+                                                     tmp_path):
+    _arm(monkeypatch, tmp_path, k=4)
+    fed = 0.0
+    for i in range(50):
+        w = float(50 - i)
+        meter.note_request(f"t{i:02d}", int(w))
+        fed += w
+    doc = meter.export_doc()
+    rows = doc["rows"]
+    assert len(rows) <= 4 + 1 and meter.OTHER in rows
+    assert sum(rows.values()) == pytest.approx(fed)
+    census = meter.meterz_doc()
+    assert census["axes"]["rows"]["total"] == pytest.approx(fed)
+
+
+# ----------------------------------------------------------- governor
+def test_export_cardinality_at_10k_tenants(monkeypatch, tmp_path):
+    """The acceptance bound: 10k distinct tenants, and every exported
+    metric family still carries at most K+1 ``tenant=`` labels."""
+    _arm(monkeypatch, tmp_path, k=32)
+    for i in range(10_000):
+        meter.note_dispatch(f"t{i:05d}:k", 1e-4 * (1 + i % 7))
+        meter.note_request(f"t{i:05d}", 4)
+    lines = export.render_meter_lines(meter.export_doc())
+    per_family = {}
+    for ln in lines:
+        m = re.match(r'(hpnn_meter_\w+_total)\{tenant="([^"]+)"\}', ln)
+        if m:
+            per_family.setdefault(m.group(1), set()).add(m.group(2))
+    assert set(per_family) == {"hpnn_meter_device_seconds_total",
+                               "hpnn_meter_rows_total"}
+    for fam, tenants in per_family.items():
+        assert len(tenants) <= 33, fam
+        assert meter.OTHER in tenants, fam
+    # conservation holds in the same regime
+    doc = meter.export_doc()
+    assert sum(doc["rows"].values()) == pytest.approx(40_000.0)
+
+
+def test_tenant_label_routes_topk_when_armed(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path, k=2)
+    meter.note_dispatch("big:k", 10.0)
+    meter.note_dispatch("med:k", 5.0)
+    meter.note_dispatch("small:k", 0.1)
+    assert meter.tenant_label("big") == "big"
+    assert meter.tenant_label("med") == "med"
+    assert meter.tenant_label("small") == meter.OTHER
+    assert meter.tenant_label("never-seen") == meter.OTHER
+
+
+def test_quota_gauges_carry_governed_labels(monkeypatch, tmp_path):
+    """The quota layer's per-tenant gauges (the PR-17 cardinality
+    bomb) route labels through the governor; the shed *count* events
+    keep the real tenant name for the alert→capsule path."""
+    sink = _arm(monkeypatch, tmp_path, k=2)
+    meter.note_dispatch("big:k", 10.0)
+    meter.note_dispatch("med:k", 5.0)
+    for t in ("big", "med"):      # heavier shedders than the tail, so
+        for _ in range(3):        # "tail" is outside EVERY axis's top-K
+            meter.note_shed(t)
+    clk = FakeClock()
+    q = QuotaEnforcer({"tail": TenantSpec("tail", "gold", rate_rps=1.0,
+                                          burst_s=1.0)}, clock=clk)
+    q.admit("big")
+    q.admit("tail")               # burns the one token
+    with pytest.raises(QuotaExceeded):
+        q.admit("tail")
+    obs.flush()
+    recs = _read(sink)
+    inflight = [r for r in recs if r.get("ev") == "tenant.inflight"]
+    assert inflight and inflight[0]["tenant"] == "big"
+    rates = [r for r in recs if r.get("ev") == "tenant.shed_rate"]
+    assert rates and rates[-1]["tenant"] == meter.OTHER
+    sheds = [r for r in recs if r.get("ev") == "tenant.shed"]
+    assert sheds and sheds[-1]["tenant"] == "tail"   # real name kept
+    # ...and the shed tap billed the real tenant on the sheds axis
+    assert meter.sketch_doc()["axes"]["sheds"]["entries"]["tail"] == \
+        [1.0, 0.0]
+
+
+# ------------------------------------------------------- serving path
+def test_serve_dispatch_and_queue_feed_the_sketches(monkeypatch,
+                                                    tmp_path):
+    """The real serve path attributes device and queue seconds to the
+    owner tenant (the ``tenant:`` prefix), and the throttled
+    ``meter.sketch`` record lands in the sink on flush."""
+    sink = _arm(monkeypatch, tmp_path)
+    kern, _ = kernel_mod.generate(17, 8, [5], 2)
+    sess = serve.Session(max_batch=8, n_buckets=1, max_wait_ms=0.5)
+    try:
+        sess.register_kernel("acme:srv", kern)
+        rng = np.random.RandomState(5)
+        for _ in range(8):
+            sess.infer("acme:srv", rng.normal(size=8))
+    finally:
+        sess.close()
+    doc = meter.export_doc()
+    assert doc["device_s"].get("acme", 0.0) > 0.0
+    assert doc["queue_s"].get("acme", 0.0) >= 0.0
+    meter.emit_sketch()
+    obs.flush()
+    recs = [r for r in _read(sink) if r.get("ev") == "meter.sketch"]
+    assert recs
+    last = recs[-1]
+    assert last["k"] == meter.DEFAULT_TOPK
+    assert "acme" in last["axes"]["device_s"]["entries"]
+    assert "acme" in last["export"]["device_s"]
+
+
+def test_capture_capsule_carries_meter_json(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    monkeypatch.setenv("HPNN_CAPSULE_DIR", str(tmp_path / "caps"))
+    monkeypatch.setenv("HPNN_CAPSULE_PROFILE_MS", "0")
+    obs._reset_for_tests()
+    meter.note_dispatch("acme:k", 0.25)
+    man = triggers.capture("manual")
+    assert man is not None and "meter.json" in man["files"]
+    doc = json.load(open(os.path.join(man["capsule"], "meter.json")))
+    assert doc["axes"]["device_s"]["entries"]["acme"][0] == \
+        pytest.approx(0.25)
+    assert doc["export"]["device_s"]["acme"] == pytest.approx(0.25)
+
+
+def test_capture_without_meter_has_no_artifact(monkeypatch, tmp_path):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.delenv("HPNN_METER", raising=False)
+    monkeypatch.setenv("HPNN_CAPSULE_DIR", str(tmp_path / "caps"))
+    monkeypatch.setenv("HPNN_CAPSULE_PROFILE_MS", "0")
+    obs._reset_for_tests()
+    man = triggers.capture("manual")
+    assert man is not None and "meter.json" not in man["files"]
+
+
+# --------------------------------------------------------- blame table
+def test_tenant_report_merge_matches_meter_merge():
+    """tools/tenant_report.py re-implements the fleet merge stdlib-only
+    (its docstring promises this test); on non-truncating inputs the
+    two implementations must agree exactly."""
+    tenant_report = _load_tool("tenant_report")
+    docs = []
+    for weights in ([("x", 5.0), ("y", 2.0)],
+                    [("y", 7.0), ("w", 3.0)],
+                    [("x", 1.0), ("q", 4.0)]):
+        docs.append({"k": 8, "tenants_seen": 4,
+                     "axes": {"device_s": _mk(weights).to_doc()}})
+    ours = meter.merge_sketch_docs(docs, k=8)
+    theirs = tenant_report.merge_docs(docs)
+    dev = theirs["axes"]["device_s"]
+    assert dev["total"] == pytest.approx(
+        ours["axes"]["device_s"]["total"])
+    # exact inputs (err=0, no truncation): lower bounds == counts,
+    # so the governed top view equals the merged entries verbatim
+    assert ours["axes"]["device_s"]["top"] == \
+        {t: round(c - e, 9) for t, (c, e) in dev["entries"].items()}
+    assert theirs["k"] == ours["k"] == 8
+
+
+def test_tenant_report_blames_the_hog_within_5pct(monkeypatch,
+                                                  tmp_path):
+    """End-to-end through the sink: known attribution (hog burns 60%
+    of device seconds), two cumulative emissions (the loader must keep
+    the latest, not sum a worker against itself), then the blame table
+    names the hog with its share within the 5% acceptance bar."""
+    sink = _arm(monkeypatch, tmp_path)
+    tenant_report = _load_tool("tenant_report")
+    for _ in range(10):
+        meter.note_dispatch("hog:k", 0.3)
+        meter.note_dispatch("v-00:k", 0.15)
+        meter.note_dispatch("v-01:k", 0.05)
+    meter.emit_sketch()               # mid-run cumulative record
+    meter.note_shed("hog")
+    meter.emit_sketch()               # final cumulative record
+    obs.flush()
+    docs = tenant_report.load_meter_docs([str(sink)])
+    assert len(docs) == 1             # latest-wins, one worker
+    rep = tenant_report.analyze(docs, top=3)
+    assert rep["ranked_by"] == "device_s"
+    top = rep["tenants"][0]
+    assert top["tenant"] == "hog"
+    assert top["share_pct"] == pytest.approx(60.0, abs=5.0)
+    assert top["sheds"] == pytest.approx(1.0)
+    assert rep["totals"]["device_s"] == pytest.approx(5.0)  # not 10:
+    # a summed-cumulative bug would double the fleet total
+    text = tenant_report.render(rep)
+    assert "hog" in text and "_other" in text
+
+
+# ---------------------------------------------------------------- lint
+def test_lint_meter_passes_a_real_sink_and_bites_on_bad(monkeypatch,
+                                                        tmp_path):
+    cat = _load_tool("check_obs_catalog")
+    sink = _arm(monkeypatch, tmp_path, k=2)
+    for i in range(8):
+        meter.note_dispatch(f"t{i}:k", 0.01 * (i + 1))
+    meter.emit_sketch()
+    obs.flush()
+    assert cat.lint_meter(str(sink)) == []
+    # quiet sink: armed lint run with no meter records must fail
+    quiet = tmp_path / "quiet.jsonl"
+    quiet.write_text('{"ev": "serve.request"}\n')
+    assert cat.lint_meter(str(quiet))
+    # crafted violations: err > count, > k named exports, and a
+    # truncated sketch whose export lost the _other rollup
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps({
+        "ev": "meter.sketch", "k": 1, "tenants_seen": 3,
+        "axes": {"device_s": {"total": 6.0,
+                              "entries": {"a": [1.0, 2.0],
+                                          "b": [2.0, 0.0],
+                                          "c": [3.0, 0.0]}}},
+        "export": {"device_s": {"a": 1.0, "b": 2.0, "c": 3.0}},
+    }) + "\n")
+    failures = cat.lint_meter(str(bad))
+    assert len(failures) >= 3

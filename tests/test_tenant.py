@@ -624,9 +624,13 @@ def test_loadgen_zipf_helpers_and_by_tenant_summary():
         {"status": "ok", "latency_ms": 1.5},     # untagged: no tenant
     ]
     summary = lg.summarize(recs, 1.0)
-    assert summary["by_tenant"] == {
-        "a": {"requests": 2, "ok": 2, "shed": 0},
-        "b": {"requests": 1, "ok": 0, "shed": 1},
-    }
+    a, b = summary["by_tenant"]["a"], summary["by_tenant"]["b"]
+    assert (a["requests"], a["ok"], a["shed"]) == (2, 2, 0)
+    assert (b["requests"], b["ok"], b["shed"]) == (1, 0, 1)
+    # per-tenant served-latency tail: p50/p99 over ok outcomes only,
+    # None for a tenant with nothing served
+    assert a["p50_ms"] == pytest.approx(1.5)
+    assert a["p99_ms"] == pytest.approx(1.99)
+    assert b["p50_ms"] is None and b["p99_ms"] is None
     # an untenanted run keeps the old summary shape exactly
     assert "by_tenant" not in lg.summarize(recs[3:], 1.0)

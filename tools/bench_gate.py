@@ -170,6 +170,19 @@ GATE_METRICS = {
     "tenant_goodput_rps": ("higher", 0.40),
     "drill_quota_victim_p99_ms": ("lower", 1.50),
     "drill_quota_victim_goodput_ratio": ("higher", 0.30),
+    # tenant metering fold-ins (bench.py bench_meter_overhead +
+    # tools/chaos_drill.py run_bench_hog_drill;
+    # docs/observability.md "Tenant metering"): the paired marginal
+    # cost of armed per-tenant sketches on the serve hot path
+    # (acceptance bar <=5% — medians hover near zero, so the
+    # tolerance is wide like the other overhead gates), the share of
+    # fleet device-seconds tenant_report blames on the drill's 20x
+    # hog (the attribution must keep naming the offender — the
+    # acceptance floor is 50%, the gate guards the trajectory), and
+    # how long the fleet-merged top-K takes to name it
+    "meter_overhead_pct": ("lower", 2.00),
+    "drill_hog_blame_pct": ("higher", 0.30),
+    "drill_hog_detect_s": ("lower", 1.50),
 }
 
 

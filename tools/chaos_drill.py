@@ -71,21 +71,39 @@ catalog (docs/resilience.md):
   post-shift sketches, with serving answering throughout (zero
   lost) and detection latency bounded.
 
+* **quota** — the multi-tenant isolation proof (docs/tenancy.md):
+  two gold victims and one rate-capped bronze offender share an
+  in-process TenantSession; the offender overdrives its admission
+  budget 10x.  Asserts the victims' goodput and p99 hold, every
+  refusal is a clean ``shed reason=quota`` naming its tenant, and
+  the ``tenant.shed_rate`` threshold rule fires.
+
+* **hog** — the per-tenant metering proof (docs/observability.md
+  "Tenant metering"): a zipf tenant population under ``HPNN_METER``,
+  then one rate-capped tenant offers 20x the zipf head's rate.
+  Asserts the fleet-merged top-K from the sink's ``meter.sketch``
+  stream names the hog within a bounded window,
+  ``tools/tenant_report.py`` blames it for the majority of
+  device-seconds, the shed-rate rule fires, and the alert-triggered
+  capsule carries ``meter.json``.
+
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
 ``drill.reload`` | ``drill.sentinel`` | ``drill.replica`` |
 ``drill.alert`` | ``drill.worker`` | ``drill.capsule`` |
-``drill.drift``;
+``drill.drift`` | ``drill.quota`` | ``drill.hog``;
 :func:`run_bench_drill` /
 :func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` /
 :func:`run_bench_worker_drill` / :func:`run_bench_capsule_drill` /
-:func:`run_bench_drift_drill` are
+:func:`run_bench_drift_drill` / :func:`run_bench_quota_drill` /
+:func:`run_bench_hog_drill` are
 the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
 ``drill_replica_dip_pct`` / ``drill_replica_survivors_lost`` /
 ``drill_alert_fire_s`` / ``drill_alert_resolved`` /
 ``drill_worker_dip_pct`` / ``drill_worker_replaced_s`` /
 ``drill_capsule_capture_s`` / ``drill_capsule_blame_pct`` /
-``drill_drift_detect_s``, gated by
+``drill_drift_detect_s`` / ``drill_quota_victim_goodput_ratio`` /
+``drill_hog_blame_pct`` / ``drill_hog_detect_s``, gated by
 ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
@@ -1262,6 +1280,229 @@ def drill_quota(workdir: str, *, rate: float = 100.0, seed: int = 9,
                 os.environ[key] = val
 
 
+def drill_hog(workdir: str, *, rate: float = 12.0, seed: int = 11,
+              phase_s: float = 1.5, warm_s: float = 0.6,
+              zipf_x: float = 20.0,
+              hog_cap_rps: float | None = None) -> dict:
+    """Resource-hog attribution drill (docs/observability.md "Tenant
+    metering"): a small zipf-weighted tenant population shares one
+    in-process TenantSession with ``HPNN_METER`` armed; after an
+    undisturbed warm phase one rate-capped tenant ("hog") offers
+    ``zipf_x`` times the heaviest victim's rate.  Proves the metering
+    plane end to end: the fleet-merged top-K (the sink's own
+    cumulative ``meter.sketch`` stream, merged exactly as the
+    collector's ``/meterz`` does) names the hog within a bounded
+    detection window (gateable ``drill_hog_detect_s``),
+    ``tools/tenant_report.py`` over the same sink blames it for the
+    majority of device-seconds (``drill_hog_blame_pct``, checked
+    against the drill's own admitted-request ground truth), the
+    ``tenant.shed_rate`` threshold rule fires on the hog's refusals,
+    and the alert-triggered capsule carries ``meter.json`` — the
+    attribution evidence frozen at fire time."""
+    import tenant_report
+
+    from hpnn_tpu import obs
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve.batcher import QueueFull
+    from hpnn_tpu.tenant import TenantSession, TenantSpec
+
+    _shield_sigpipe()
+    if hog_cap_rps is None:
+        # admit the hog at ~2.5x the victims' combined offered load:
+        # enough to dominate the device-seconds blame table, while
+        # the 20x offered overdrive keeps its shed rate over the
+        # alert rule's 0.5 threshold at any --rate
+        hog_cap_rps = 2.5 * rate
+    out: dict = {"ev": "drill.hog", "ok": False,
+                 "zipf_x": float(zipf_x),
+                 "hog_cap_rps": float(hog_cap_rps)}
+    sink = os.path.join(workdir, "hog-drill.metrics.jsonl")
+    capsule_dir = os.path.join(workdir, "capsules")
+    env_keys = ("HPNN_ALERTS", "HPNN_METRICS", "HPNN_METER",
+                "HPNN_METER_TOPK", "HPNN_CAPSULE_DIR",
+                "HPNN_CAPSULE_PROFILE_MS", "HPNN_CAPSULE_COOLDOWN_S")
+    prev_env = {key: os.environ.get(key) for key in env_keys}
+    os.environ["HPNN_ALERTS"] = ("hog_shed@tenant.shed_rate>0.5:"
+                                 "for=0,cooldown=0,severity=warn")
+    os.environ["HPNN_METER"] = "1"
+    os.environ.pop("HPNN_METER_TOPK", None)
+    os.environ["HPNN_CAPSULE_DIR"] = capsule_dir
+    os.environ["HPNN_CAPSULE_PROFILE_MS"] = "0"
+    os.environ["HPNN_CAPSULE_COOLDOWN_S"] = "0"
+    victims = tuple(f"v-{i:02d}" for i in range(4))
+    hog = "hog"
+    weights = [1.0 / (i + 1) for i in range(len(victims))]
+    scale = rate / sum(weights)
+    victim_rates = [w * scale for w in weights]
+    hog_rate = zipf_x * victim_rates[0]
+    specs = {v: TenantSpec(v, "gold") for v in victims}
+    specs[hog] = TenantSpec(hog, "bronze",
+                            rate_rps=float(hog_cap_rps))
+    session = None
+
+    def _manifest():
+        for dirpath, _dirs, files in os.walk(capsule_dir):
+            if "manifest.json" in files:
+                return os.path.join(dirpath, "manifest.json")
+        return None
+
+    def _sink_top_device():
+        """(latest ``meter.sketch`` record, its device_s leader) from
+        the live sink — the same cumulative stream a collector
+        merges for ``/meterz``."""
+        latest = None
+        try:
+            with open(sink) as fp:
+                for line in fp:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line mid-run
+                    if rec.get("ev") == "meter.sketch":
+                        latest = rec
+        except OSError:
+            return None, None
+        if latest is None:
+            return None, None
+        merged = obs.meter.merge_sketch_docs([latest])
+        top = (merged.get("axes", {}).get("device_s", {})
+               .get("top") or {})
+        named = {t: v for t, v in top.items() if t != "_other"}
+        if not named:
+            return latest, None
+        return latest, max(named, key=lambda t: (named[t], t))
+
+    def paced(tenant: str, rate_rps: float, duration_s: float,
+              res: dict):
+        period = 1.0 / max(rate_rps, 1e-6)
+        t0 = time.perf_counter()
+        i = 0
+        while i * period < duration_s:
+            due = t0 + i * period
+            i += 1
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                session.infer_for(tenant, KERNEL, x, timeout_s=2.0)
+            except QueueFull:  # Shed subclass
+                res["shed"] += 1
+            except Exception as exc:
+                res["errors"] += 1
+                res["error_sample"] = repr(exc)
+            else:
+                res["ok"] += 1
+
+    def fresh():
+        return {"ok": 0, "shed": 0, "errors": 0}
+
+    try:
+        obs.configure(sink)   # arms sink + rule + capsule + meter
+        session = TenantSession(mode="parity", fleet=True,
+                                max_wait_ms=0.5, tenants=specs)
+        k, _ = kernel_mod.generate(seed + 1, 8, [5], 2)
+        for tn in (*victims, hog):
+            session.register_for(tn, KERNEL, k)
+        x = np.random.RandomState(seed).standard_normal((2, 8))
+        for tn in (*victims, hog):
+            session.infer_for(tn, KERNEL, x)  # compile warmup
+        # zero the sketches: the one-time executable builds above cost
+        # orders of magnitude more than a steady-state dispatch and
+        # would drown the traffic signal the drill attributes
+        obs.meter.configure("1")
+
+        res = {tn: fresh() for tn in (*victims, hog)}
+        threads = [threading.Thread(
+            target=paced, args=(v, r, warm_s + phase_s, res[v]),
+            daemon=True) for v, r in zip(victims, victim_rates)]
+        for t in threads:
+            t.start()
+        time.sleep(warm_s)
+        t_attack = time.time()   # registry record ts is time.time()
+        hog_thread = threading.Thread(
+            target=paced, args=(hog, hog_rate, phase_s, res[hog]),
+            daemon=True)
+        hog_thread.start()
+        detect_ts = None
+        deadline = time.monotonic() + phase_s
+        while time.monotonic() < deadline:
+            rec, top = _sink_top_device()
+            if top == hog:
+                detect_ts = rec.get("ts")
+                break
+            time.sleep(0.02)
+        for t in threads:
+            t.join()
+        hog_thread.join()
+        obs.meter.emit_sketch()  # final cumulative sketch, unthrottled
+        manifest_path = _wait(_manifest, 10.0, interval_s=0.05)
+        census = obs.alerts.health_doc()
+        obs.configure(None)   # close the sink for a complete audit
+        events = []
+        with open(sink) as fp:
+            for line in fp:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        fires = [r for r in events if r.get("ev") == "alert.fire"
+                 and r.get("rule") == "hog_shed"]
+        rep = tenant_report.analyze(
+            tenant_report.load_meter_docs([sink]), top=3)
+        rows = {r["tenant"]: r for r in rep["tenants"]}
+        blame_pct = float((rows.get(hog) or {}).get("share_pct")
+                          or 0.0)
+        admitted = {tn: r["ok"] for tn, r in res.items()}
+        total_ok = sum(admitted.values())
+        truth_pct = (round(100.0 * admitted[hog] / total_ok, 2)
+                     if total_ok else 0.0)
+        man, meter_json = {}, None
+        if manifest_path:
+            with open(manifest_path) as fp:
+                man = json.load(fp)
+            mj = os.path.join(os.path.dirname(manifest_path),
+                              "meter.json")
+            if os.path.exists(mj):
+                with open(mj) as fp:
+                    meter_json = json.load(fp)
+        out["detect_s"] = (round(detect_ts - t_attack, 3)
+                           if detect_ts is not None else None)
+        out["ranked_top"] = (rep["tenants"][0]["tenant"]
+                             if rep["tenants"] else None)
+        out["blame_pct"] = round(blame_pct, 2)
+        out["truth_pct"] = truth_pct
+        out["hog_ok"] = admitted[hog]
+        out["hog_shed"] = res[hog]["shed"]
+        out["victims_ok"] = total_ok - admitted[hog]
+        out["errors"] = sum(r["errors"] for r in res.values())
+        out["alert_fired"] = bool(fires)
+        out["fired_total"] = census.get("fired_total", 0)
+        out["capsule"] = man.get("capsule")
+        out["capsule_reason"] = man.get("reason")
+        out["capsule_meter_axes"] = sorted(
+            (meter_json or {}).get("axes", {}))
+        out["ok"] = bool(
+            out["detect_s"] is not None and out["detect_s"] <= 1.0
+            and out["ranked_top"] == hog
+            and blame_pct >= 50.0
+            and res[hog]["shed"] > 0
+            and fires
+            and manifest_path
+            and meter_json is not None
+            and meter_json.get("axes")
+            and meter_json.get("export"))
+        return out
+    finally:
+        if session is not None:
+            session.close()
+        obs.configure(None)
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
@@ -1272,6 +1513,7 @@ DRILLS = {
     "capsule": drill_capsule,
     "drift": drill_drift,
     "quota": drill_quota,
+    "hog": drill_hog,
 }
 
 
@@ -1445,6 +1687,27 @@ def run_bench_quota_drill(*, rate: float = 100.0) -> dict:
     return out
 
 
+def run_bench_hog_drill(*, rate: float = 12.0) -> dict:
+    """The bench.py fold-in for the hog drill: one tenant at 20x the
+    zipf head's rate under an armed meter, reported as gateable
+    numbers (``drill_hog_blame_pct`` / ``drill_hog_detect_s``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_hog(tmp, rate=rate)
+    out = {
+        "metric": "hog_drill",
+        "drill": row,
+        "detect_s": row.get("detect_s"),
+        "blame_pct": row.get("blame_pct"),
+        "truth_pct": row.get("truth_pct"),
+        "alert_fired": row.get("alert_fired"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 # --------------------------------------------------------------- main
 
 
@@ -1452,11 +1715,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
                     "(kill9 / reload / sentinel / replica / alert / "
-                    "worker / capsule / drift)")
+                    "worker / capsule / drift / quota / hog)")
     ap.add_argument("--drill", default="all",
                     choices=("all", "kill9", "reload", "sentinel",
                              "replica", "alert", "worker", "capsule",
-                             "drift"))
+                             "drift", "quota", "hog"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

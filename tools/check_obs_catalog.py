@@ -130,12 +130,26 @@ refusals that must name their tenant are how an operator audits a
 shed makes the bounded-memory and isolation claims unverifiable, so
 their shapes are frozen too (docs/tenancy.md).
 
+And the tenant-metering schema lint (:func:`lint_meter`): the
+``meter.sketch`` records (obs/meter.py, HPNN_METER) carry the
+per-worker space-saving sketches the fleet merge and the
+``tenant_report`` blame table are reconstructed from — a governed
+``export`` view that exceeds its own top-K bound re-opens the
+cardinality hole the governor exists to close, a missing ``_other``
+rollup when tenants outnumber K silently drops the long tail's mass,
+a non-finite accumulator or a ``count < err`` entry poisons every
+downstream merge, and an export that doesn't conserve the axis total
+makes the "every column sums to the fleet total" contract a lie — so
+their shapes are frozen too (docs/observability.md "Tenant
+metering").
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
         [--serve-replicas PATH] [--fleet PATH] [--cluster PATH]
         [--forensics PATH] [--drift PATH] [--tenant PATH]
+        [--meter PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -2033,6 +2047,124 @@ def lint_tenant(path: str) -> list[str]:
     return failures
 
 
+def lint_meter(path: str) -> list[str]:
+    """Schema-lint the per-tenant metering records of one metrics
+    sink (a run with ``HPNN_METER`` armed — docs/observability.md
+    "Tenant metering").
+
+    Checks, per ``meter.sketch`` record:
+
+    * ``k`` — a positive integer (the governor's top-K width).
+    * every ``export`` axis — at most ``k`` named tenants plus
+      ``_other`` (the O(K) cardinality bound, held in the sink, not
+      just at render time); all values finite and >= 0.
+    * ``_other`` present whenever that axis's raw ``entries`` hold
+      more tenants than ``k`` (the long tail must roll up, not
+      vanish).
+    * every ``axes`` sketch — finite ``total`` >= 0; every entry a
+      finite ``[count, err]`` pair with ``count >= err >= 0`` (the
+      space-saving invariant every merge and lower-bound estimate
+      rests on).
+    * conservation — ``sum(export[axis].values())`` equals the axis
+      ``total`` (the export is a partition of the fleet mass, not a
+      sample of it).
+
+    A sink with no ``meter.sketch`` records fails — this lint only
+    makes sense on a meter-armed run.  Returns failure strings
+    (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_meter = 0
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict) or rec.get("ev") != "meter.sketch":
+            continue
+        n_meter += 1
+        at = f"record {i + 1}"
+        k = rec.get("k")
+        if not isinstance(k, int) or isinstance(k, bool) or k < 1:
+            failures.append(
+                f"{at}: meter.sketch k {k!r} is not a positive int")
+            continue
+        axes = rec.get("axes")
+        export = rec.get("export")
+        if not isinstance(axes, dict) or not isinstance(export, dict):
+            failures.append(
+                f"{at}: meter.sketch axes/export are not objects")
+            continue
+        for ax, doc in sorted(axes.items()):
+            total = (doc or {}).get("total")
+            if not _num(total) or not math.isfinite(total) or total < 0:
+                failures.append(
+                    f"{at}: axis {ax} total {total!r} is not a "
+                    "finite non-negative number")
+                continue
+            entries = (doc or {}).get("entries") or {}
+            bad = False
+            for t, ce in sorted(entries.items()):
+                try:
+                    c, e = float(ce[0]), float(ce[1])
+                except (TypeError, ValueError, IndexError):
+                    failures.append(
+                        f"{at}: axis {ax} tenant {t!r} entry {ce!r} "
+                        "is not a [count, err] pair")
+                    bad = True
+                    continue
+                if (not math.isfinite(c) or not math.isfinite(e)
+                        or not c >= e >= 0):
+                    failures.append(
+                        f"{at}: axis {ax} tenant {t!r} entry "
+                        f"[{c!r}, {e!r}] breaks count >= err >= 0 — "
+                        "the space-saving invariant")
+                    bad = True
+            exp = export.get(ax)
+            if not isinstance(exp, dict):
+                failures.append(
+                    f"{at}: axis {ax} has a sketch but no export "
+                    "view")
+                continue
+            named = [t for t in exp if t != "_other"]
+            if len(named) > k:
+                failures.append(
+                    f"{at}: axis {ax} export names {len(named)} "
+                    f"tenants > k={k} — the cardinality governor's "
+                    "O(K) bound is broken in the sink")
+            if len(entries) > k and "_other" not in exp:
+                failures.append(
+                    f"{at}: axis {ax} tracks {len(entries)} tenants "
+                    f"> k={k} but exports no _other rollup — the "
+                    "long tail's mass vanished")
+            s = 0.0
+            for t, v in sorted(exp.items()):
+                if not _num(v) or not math.isfinite(v) or v < 0:
+                    failures.append(
+                        f"{at}: axis {ax} export {t!r} value {v!r} "
+                        "is not a finite non-negative number")
+                    bad = True
+                    continue
+                s += v
+            if not bad and abs(s - total) > 1e-6 + 1e-6 * abs(total):
+                failures.append(
+                    f"{at}: axis {ax} export sums to {s!r} != total "
+                    f"{total!r} — the top-K + _other partition does "
+                    "not conserve the fleet mass")
+    if not n_meter:
+        failures.append(
+            f"sink {path!r} has no meter.sketch records — was "
+            "HPNN_METER armed during this run?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -2118,6 +2250,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_tenant(argv[i + 1])
+    if "--meter" in argv:
+        i = argv.index("--meter")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --meter needs a "
+                             "path\n")
+            return 2
+        failures += lint_meter(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -235,6 +235,7 @@ def check(tmpdir: str) -> list[str]:
     # the profiler window off so the capsule is just files
     from hpnn_tpu.obs import drift as drift_mod
     from hpnn_tpu.obs import forensics as forensics_mod
+    from hpnn_tpu.obs import meter as meter_mod
     from hpnn_tpu.obs import triggers as triggers_mod
 
     capsule_dir = os.path.join(tmpdir, "capsules")
@@ -247,6 +248,11 @@ def check(tmpdir: str) -> list[str]:
     # the online trainer's holdout evals, none of which a plain train
     # round touches — armed, it must stay inert on stdout and the sink
     os.environ["HPNN_DRIFT"] = "1"
+    # per-tenant metering (docs/observability.md "Tenant metering")
+    # rides the same proof: taps sit on serve dispatch / the batcher
+    # queue edge / tenant admission, none of which a plain train round
+    # touches — armed, it must stay inert on stdout and the sink
+    os.environ["HPNN_METER"] = "1"
     for knob, val in _ONLINE_KNOBS:
         os.environ[knob] = val
     chaos_mod._reset_for_tests()
@@ -254,6 +260,7 @@ def check(tmpdir: str) -> list[str]:
     forensics_mod._reset_for_tests()
     triggers_mod._reset_for_tests()
     drift_mod._reset_for_tests()
+    meter_mod._reset_for_tests()
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
@@ -265,7 +272,8 @@ def check(tmpdir: str) -> list[str]:
                      "HPNN_COLLECTOR_FLUSH_S", "HPNN_ALERTS",
                      "HPNN_SAMPLE", "HPNN_CAPSULE_DIR",
                      "HPNN_CAPSULE_PROFILE_MS",
-                     "HPNN_CAPSULE_COOLDOWN_S", "HPNN_DRIFT") \
+                     "HPNN_CAPSULE_COOLDOWN_S", "HPNN_DRIFT",
+                     "HPNN_METER") \
                 + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
         chaos_mod._reset_for_tests()
@@ -273,6 +281,7 @@ def check(tmpdir: str) -> list[str]:
         forensics_mod._reset_for_tests()
         triggers_mod._reset_for_tests()
         drift_mod._reset_for_tests()
+        meter_mod._reset_for_tests()
 
     if plain != instrumented:
         failures.append(
@@ -282,7 +291,8 @@ def check(tmpdir: str) -> list[str]:
             "HPNN_WAL_DIR + HPNN_COLLECTOR (live push) + HPNN_ALERTS "
             "(firing rule) + HPNN_SAMPLE + HPNN_CAPSULE_DIR "
             "(alert-triggered capture) + HPNN_DRIFT (armed "
-            "sketches) + HPNN_ONLINE_* (incl. "
+            "sketches) + HPNN_METER (armed metering) + "
+            "HPNN_ONLINE_* (incl. "
             "HPNN_ONLINE_SCAN_K) + "
             "HPNN_SERVE_DTYPE=bf16 + export server all enabled "
             f"(plain {len(plain)}B vs instrumented {len(instrumented)}B)")

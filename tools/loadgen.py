@@ -136,6 +136,7 @@ def summarize(records: list[dict], duration_s: float, *,
     if offered_rps is None:
         offered_rps = n / duration_s if duration_s else 0.0
     by_tenant: dict[str, dict] = {}
+    tenant_lat_s: dict[str, list[float]] = {}
     for r in records:
         t = r.get("tenant")
         if t is None:
@@ -145,6 +146,15 @@ def summarize(records: list[dict], duration_s: float, *,
         d["requests"] += 1
         if r["status"] in d:
             d[r["status"]] += 1
+        if r["status"] == "ok":
+            tenant_lat_s.setdefault(t, []).append(
+                r["latency_ms"] / 1e3)
+    for t, d in by_tenant.items():
+        # per-tenant served-latency tail so drill assertions can
+        # check victim-vs-offender p99 without scraping /metrics
+        lat = tenant_lat_s.get(t)
+        d["p50_ms"] = percentile_ms(lat, 50) if lat else None
+        d["p99_ms"] = percentile_ms(lat, 99) if lat else None
     out = {
         "requests": n,
         "duration_s": round(duration_s, 3),

@@ -1,0 +1,260 @@
+#!/usr/bin/env python3
+"""Per-tenant resource blame table over one or more obs sinks.
+
+The meter plane (``HPNN_METER``, obs/meter.py) writes throttled
+``meter.sketch`` records — cumulative per-worker space-saving sketches
+of device dispatch seconds, FLOPs, bytes, queue-wait seconds, rows
+served, and shed counts, attributed to tenants.  This tool ingests
+the sinks the fleet already writes (worker ``HPNN_METRICS`` files
+and/or the collector's merged stream), keeps each worker's **latest**
+sketch (they are cumulative — summing a worker against itself would
+double-count), merges them with the same commutative rule the
+collector's ``/meterz`` uses (totals add, shared tenants sum count
+and error), and prints the per-tenant blame table: device-seconds,
+FLOPs, bytes, queue-seconds, rows, sheds, and each tenant's
+share-of-fleet device time.  The long tail past ``--top`` rolls into
+``_other`` with every column conserving the fleet total exactly —
+this is the programmatic input ROADMAP item 5's quota-pressure
+remediation consumes, and the drill's "name the hog" oracle
+(``tools/chaos_drill.py --drill hog``).
+
+With ``--baseline``, a second sink set renders a paired comparison —
+per-axis fleet deltas and per-tenant device-second shifts — so "the
+new release doubled tenant X's device share" is one command.
+
+Per-tenant values are space-saving **lower bounds** (``count - err``;
+exact for tenants that never left the sketch), so a reported share
+can understate but never invent mass; the ``_other`` remainder
+absorbs the difference.
+
+Usage::
+
+    python tools/tenant_report.py run.jsonl [more.jsonl ...]
+    python tools/tenant_report.py run.jsonl --top 10
+    python tools/tenant_report.py run.jsonl --baseline before.jsonl
+    python tools/tenant_report.py run.jsonl --json
+
+stdlib-only: the report must render on a login node with no jax
+installed (the merge is re-implemented here rather than imported
+from ``hpnn_tpu.obs.meter``; tests/test_meter.py pins the two
+implementations equal).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+AXES = ("device_s", "flops", "bytes", "queue_s", "rows", "sheds")
+OTHER = "_other"
+
+
+def load_meter_docs(paths: list[str]) -> list[dict]:
+    """The latest ``meter.sketch`` record per worker across the sink
+    set.  Worker identity is ``(path, pid, rank)`` — a collector's
+    merged stream tags every record with the sender's pid/rank, a
+    worker's own sink may not (then the file stands for the worker)."""
+    latest: dict = {}
+    for path in paths:
+        with open(path) as fp:
+            for ln in fp:
+                try:
+                    rec = json.loads(ln)
+                except ValueError:
+                    continue  # torn tail line
+                if not isinstance(rec, dict) \
+                        or rec.get("ev") != "meter.sketch":
+                    continue
+                key = (path, rec.get("pid"), rec.get("rank"))
+                latest[key] = rec  # later line wins: cumulative
+    return [latest[k] for k in sorted(latest, key=str)]
+
+
+def merge_docs(docs: list[dict]) -> dict:
+    """Commutative fleet merge of ``meter.sketch`` docs — same rule as
+    ``meter.merge_sketch_docs``: per axis, totals add and shared
+    tenants sum ``[count, err]``.  Returns ``{"k", "tenants_seen",
+    "axes": {axis: {"total", "entries"}}}``."""
+    k = max([int(d.get("k") or 32) for d in docs] or [32])
+    seen = 0
+    axes: dict[str, dict] = {}
+    for d in docs:
+        seen = max(seen, int(d.get("tenants_seen") or 0))
+        for ax, doc in (d.get("axes") or {}).items():
+            m = axes.setdefault(ax, {"total": 0.0, "entries": {}})
+            m["total"] += float(doc.get("total") or 0.0)
+            for t, ce in (doc.get("entries") or {}).items():
+                try:
+                    c, e = float(ce[0]), float(ce[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                cur = m["entries"].get(t)
+                if cur is None:
+                    m["entries"][t] = [c, e]
+                else:
+                    cur[0] += c
+                    cur[1] += e
+    return {"k": k, "tenants_seen": seen, "axes": axes}
+
+
+def analyze(docs: list[dict], *, top: int = 10) -> dict:
+    """The machine-form blame table: the top-``top`` tenants ranked by
+    estimated device-seconds (falling back to rows, then any axis,
+    for meter streams with no dispatch traffic), one row per tenant
+    with every axis's lower-bound estimate, the tail as ``_other``,
+    per-axis fleet totals conserved exactly."""
+    merged = merge_docs(docs)
+    axes = merged["axes"]
+
+    def _est(ax: str, tenant: str) -> float:
+        ce = axes.get(ax, {}).get("entries", {}).get(tenant)
+        return max(0.0, ce[0] - ce[1]) if ce else 0.0
+
+    rank_ax = next((ax for ax in ("device_s", "rows") if axes.get(ax)),
+                   None) or next(iter(sorted(axes)), "device_s")
+    candidates = set()
+    for ax in axes:
+        candidates.update(axes[ax].get("entries", ()))
+    ranked = sorted(candidates,
+                    key=lambda t: (-_est(rank_ax, t), t))[:max(1, top)]
+
+    totals = {ax: float(axes.get(ax, {}).get("total") or 0.0)
+              for ax in AXES}
+    dev_total = totals.get("device_s") or 0.0
+    rows = []
+    for t in ranked:
+        row = {"tenant": t}
+        for ax in AXES:
+            row[ax] = round(_est(ax, t), 9)
+        row["share_pct"] = (round(100.0 * row["device_s"] / dev_total, 2)
+                            if dev_total > 0 else 0.0)
+        rows.append(row)
+    other = {"tenant": OTHER}
+    for ax in AXES:
+        rest = totals[ax] - sum(r[ax] for r in rows)
+        other[ax] = round(max(rest, 0.0), 9)
+    other["share_pct"] = (round(100.0 * other["device_s"] / dev_total, 2)
+                          if dev_total > 0 else 0.0)
+    if candidates or any(totals.values()):
+        rows.append(other)
+    return {
+        "workers": len(docs),
+        "k": merged["k"],
+        "tenants_seen": merged["tenants_seen"],
+        "ranked_by": rank_ax,
+        "totals": {ax: round(v, 9) for ax, v in totals.items()},
+        "tenants": rows,
+    }
+
+
+def compare(rep: dict, base: dict) -> dict:
+    """The paired ``--baseline`` digest: per-axis fleet-total deltas
+    plus per-tenant device-second / share shifts for every tenant
+    named in either report."""
+    run_rows = {r["tenant"]: r for r in rep["tenants"]}
+    base_rows = {r["tenant"]: r for r in base["tenants"]}
+    tenants = {}
+    for t in sorted(set(run_rows) | set(base_rows)):
+        r = run_rows.get(t, {})
+        b = base_rows.get(t, {})
+        tenants[t] = {
+            "device_s": {"run": r.get("device_s", 0.0),
+                         "baseline": b.get("device_s", 0.0),
+                         "delta": round(r.get("device_s", 0.0)
+                                        - b.get("device_s", 0.0), 9)},
+            "share_pct_delta": round(r.get("share_pct", 0.0)
+                                     - b.get("share_pct", 0.0), 2),
+        }
+    return {
+        "totals_delta": {
+            ax: round(rep["totals"][ax] - base["totals"][ax], 9)
+            for ax in AXES},
+        "tenants": tenants,
+    }
+
+
+def _num(v: float) -> str:
+    if v == 0:
+        return "0"
+    if abs(v) >= 1e6 or (0 < abs(v) < 1e-3):
+        return f"{v:.3g}"
+    return f"{v:.4f}".rstrip("0").rstrip(".")
+
+
+def render(rep: dict, cmp_doc: dict | None = None) -> str:
+    out: list[str] = []
+    w = out.append
+    w("== tenant report ==")
+    w(f"workers: {rep['workers']}   tenants seen: "
+      f"{rep['tenants_seen']}   top-K (governor): {rep['k']}")
+    if not rep["tenants"]:
+        w("  (no meter.sketch records — was HPNN_METER armed on the "
+          "serving path?)")
+        return "\n".join(out) + "\n"
+    w("")
+    w(f"  {'tenant':16s} {'device_s':>11s} {'share':>7s} {'flops':>11s}"
+      f" {'bytes':>11s} {'queue_s':>10s} {'rows':>9s} {'sheds':>7s}")
+    for r in rep["tenants"]:
+        w(f"  {r['tenant']:16s} {_num(r['device_s']):>11s}"
+          f" {r['share_pct']:6.2f}% {_num(r['flops']):>11s}"
+          f" {_num(r['bytes']):>11s} {_num(r['queue_s']):>10s}"
+          f" {_num(r['rows']):>9s} {_num(r['sheds']):>7s}")
+    w("")
+    w("-- fleet totals --")
+    for ax in AXES:
+        w(f"  {ax:10s} {_num(rep['totals'][ax]):>14s}")
+    if cmp_doc is not None:
+        w("")
+        w("-- vs baseline --")
+        for ax in AXES:
+            d = cmp_doc["totals_delta"][ax]
+            if d:
+                w(f"  {ax:10s} {d:+.6g}")
+        for t, doc in cmp_doc["tenants"].items():
+            d = doc["device_s"]["delta"]
+            pp = doc["share_pct_delta"]
+            if d or pp:
+                w(f"  {t:16s} device_s {d:+.6g}   share {pp:+.2f} pp")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-tenant resource blame table over HPNN_METER "
+                    "sketches in HPNN_METRICS sinks")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="metrics JSONL sink(s); latest sketch per "
+                         "worker, merged fleet-wide")
+    ap.add_argument("--top", type=int, default=10, metavar="N",
+                    help="tenants ranked before the _other rollup "
+                         "(default 10)")
+    ap.add_argument("--baseline", nargs="+", metavar="path",
+                    help="baseline sink(s): append a paired "
+                         "comparison (per-tenant deltas)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine form instead of text")
+    args = ap.parse_args(argv)
+    try:
+        rep = analyze(load_meter_docs(args.paths), top=args.top)
+        cmp_doc = None
+        if args.baseline:
+            base = analyze(load_meter_docs(args.baseline),
+                           top=args.top)
+            cmp_doc = compare(rep, base)
+    except OSError as exc:
+        sys.stderr.write(f"tenant_report: {exc}\n")
+        return 1
+    if args.json:
+        doc = dict(rep)
+        if cmp_doc is not None:
+            doc["baseline"] = cmp_doc
+        json.dump(doc, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render(rep, cmp_doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
