@@ -723,6 +723,95 @@ def bench_sampler_overhead(iters: int = 200, repeats: int = 5):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_blame_overhead(iters: int = 200, repeats: int = 5):
+    """Paired measurement of the online blame engine's MARGINAL cost
+    on the serve hot path: the same ``Session.infer`` loop with the
+    JSONL sink AND ``HPNN_SAMPLE=1`` armed in BOTH legs (blame only
+    sees sampler-emitted request roots, so the sampler must run in
+    both to isolate blame's delta), plus — in the "on" leg only —
+    ``HPNN_BLAME=1`` (every root's subtree classified and folded into
+    the rolling window).  Quantifies the claim that live per-phase
+    blame attribution is affordable (docs/selftuning.md;
+    tools/bench_gate.py gates ``blame_overhead_pct``)."""
+    from hpnn_tpu import obs, serve
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.obs import blame
+
+    prev_sink = obs.sink_path() if obs.enabled() else None
+    d = tempfile.mkdtemp(prefix="hpnn_blame_bench_")
+    saved = {k: os.environ.pop(k, None)
+             for k in ("HPNN_SAMPLE", "HPNN_BLAME")}
+
+    def arm(on: bool, sink: str) -> None:
+        # obs.configure re-runs the reset chain, so the sampler and
+        # blame memos re-read their knobs on the next request
+        os.environ["HPNN_SAMPLE"] = "1"
+        if on:
+            os.environ["HPNN_BLAME"] = "1"
+        else:
+            os.environ.pop("HPNN_BLAME", None)
+        obs.configure(sink)
+
+    n_in, n_hid, n_out = FLEET_SHAPE
+    kern = kernel_mod.generate(4243, n_in, [n_hid], n_out)[0]
+    x = np.random.RandomState(3).normal(size=n_in)
+    sess = None
+    try:
+        sess = serve.Session(max_batch=8, n_buckets=2,
+                             max_wait_ms=0.5)
+        sess.register_kernel("bench", kern)
+
+        # warm both legs (compile, sink open, sampler + blame memos)
+        arm(False, os.path.join(d, "warm_off.jsonl"))
+        for _ in range(10):
+            sess.infer("bench", x)
+        arm(True, os.path.join(d, "warm_on.jsonl"))
+        for _ in range(10):
+            sess.infer("bench", x)
+
+        on_s, off_s = [], []
+        roots_seen = 0
+        for r in range(repeats):
+            arm(False, os.path.join(d, f"off{r}.jsonl"))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                sess.infer("bench", x)
+            off_s.append(time.perf_counter() - t0)
+            arm(True, os.path.join(d, f"on{r}.jsonl"))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                sess.infer("bench", x)
+            on_s.append(time.perf_counter() - t0)
+            # the proof the "on" leg actually classified: the rolling
+            # window must have folded this leg's request roots
+            roots_seen = blame.health_doc().get("roots_seen", 0)
+        obs.configure(None)
+
+        deltas = [round(100.0 * (a - b) / b, 2)
+                  for a, b in zip(on_s, off_s)]
+        return {
+            "iters": iters,
+            "infer_s_blame_off": _stats([round(v, 4) for v in off_s]),
+            "infer_s_blame_on": _stats([round(v, 4) for v in on_s]),
+            "paired_overhead_pct": {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            },
+            "roots_seen_last_round": roots_seen,
+        }
+    finally:
+        if sess is not None:
+            sess.close()
+        obs.configure(None)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        obs.configure(prev_sink)
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_drift_overhead(iters: int = 200, repeats: int = 5):
     """Paired measurement of the drift plane's MARGINAL cost on the
     two hot paths it taps: the same ``Session.infer`` +
@@ -1319,6 +1408,15 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["sampler_overhead_error"] = repr(exc)
 
+    # online-blame overhead: the same paired shape on the SERVE hot
+    # path with the sampler armed in both legs, HPNN_BLAME=1 in one
+    # (docs/selftuning.md) — rides the same skip knob, best-effort
+    if not os.environ.get("HPNN_BENCH_NO_OBS_OVERHEAD"):
+        try:
+            out["blame_overhead"] = bench_blame_overhead()
+        except Exception as exc:
+            out["blame_overhead_error"] = repr(exc)
+
     # drift-sketch overhead: the same paired shape on the serve +
     # ingest hot paths, HPNN_DRIFT=1 in one leg (docs/observability.md
     # "Drift detection") — rides the same skip knob, best-effort
@@ -1610,6 +1708,21 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["hog_drill_error"] = repr(exc)
 
+    # Tune drill (tools/chaos_drill.py run_bench_tune_drill): per
+    # blame class, a dominant synthetic window must move the matching
+    # knob through the real actuators, recover through the watch, and
+    # roll two bad moves back bitwise (docs/selftuning.md).  Rides
+    # the same HPNN_BENCH_NO_DRILL knob (in-process, deterministic).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["tune_drill"] = chaos_drill.run_bench_tune_drill()
+        except Exception as exc:
+            out["tune_drill_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -1755,6 +1868,11 @@ def main(argv=None) -> None:
         compact["drill_hog_blame_pct"] = hd["blame_pct"]
         compact["drill_hog_detect_s"] = hd["detect_s"]
         compact["drill_hog_alert_fired"] = hd["alert_fired"]
+    if ("tune_drill" in out
+            and out["tune_drill"].get("applies") is not None):
+        td = out["tune_drill"]
+        compact["drill_tune_applies"] = td["applies"]
+        compact["drill_tune_rollback_bitwise"] = td["rollback_bitwise"]
     if ("autoscale" in out
             and out["autoscale"].get("goodput_x") is not None):
         asc = out["autoscale"]
@@ -1773,6 +1891,10 @@ def main(argv=None) -> None:
     if "sampler_overhead" in out:
         compact["sampler_overhead_pct"] = (
             out["sampler_overhead"]["paired_overhead_pct"]["median"]
+        )
+    if "blame_overhead" in out:
+        compact["blame_overhead_pct"] = (
+            out["blame_overhead"]["paired_overhead_pct"]["median"]
         )
     if "drift_overhead" in out:
         compact["drift_overhead_pct"] = (

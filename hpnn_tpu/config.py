@@ -498,6 +498,41 @@ KNOBS = {
     "HPNN_FLEET_DOWN_FOR_S": {
         "default": 5.0, "doc": "docs/serving.md",
         "desc": "calm must be sustained this long before scaling down"},
+    "HPNN_FLEET_UP_SLOPE": {
+        "default": 0, "doc": "docs/serving.md",
+        "desc": "predictive scale-up on load ramp (rows/worker/s; 0=off)"},
+    "HPNN_FLEET_SLOPE_FOR_S": {
+        "default": 3.0, "doc": "docs/serving.md",
+        "desc": "trailing window the predictive ramp is fit on"},
+    # --- online blame attribution (docs/selftuning.md) ---
+    "HPNN_BLAME": {
+        "default": None, "doc": "docs/selftuning.md",
+        "desc": "arm the online per-phase blame engine (rolling gauges)"},
+    "HPNN_BLAME_WINDOW": {
+        "default": 128, "doc": "docs/selftuning.md",
+        "desc": "blame rolling window size in request roots (floor 16)"},
+    # --- self-tuning remediation (docs/selftuning.md) ---
+    "HPNN_TUNE": {
+        "default": None, "doc": "docs/selftuning.md",
+        "desc": "arm the audited self-tuning remediation plane"},
+    "HPNN_TUNE_DOMINANT_PCT": {
+        "default": 40.0, "doc": "docs/selftuning.md",
+        "desc": "blame share a phase needs before its knob may move"},
+    "HPNN_TUNE_BURN": {
+        "default": 1.0, "doc": "docs/selftuning.md",
+        "desc": "SLO burn-rate gate: no action while burn is below it"},
+    "HPNN_TUNE_COOLDOWN_S": {
+        "default": 30.0, "doc": "docs/selftuning.md",
+        "desc": "minimum seconds between applied tune actions"},
+    "HPNN_TUNE_WATCH_S": {
+        "default": 10.0, "doc": "docs/selftuning.md",
+        "desc": "post-apply regression watch window (rollback inside it)"},
+    "HPNN_TUNE_QUANT_ERR": {
+        "default": 0.01, "doc": "docs/selftuning.md",
+        "desc": "measured quant-error bound gating precision_down"},
+    "HPNN_TUNE_DRY": {
+        "default": None, "doc": "docs/selftuning.md",
+        "desc": "shadow mode: decide and ledger but never actuate"},
     # --- online learning (docs/online.md) ---
     "HPNN_ONLINE_BUFFER": {
         "default": 1024, "doc": "docs/online.md",

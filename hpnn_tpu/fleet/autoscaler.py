@@ -24,7 +24,11 @@ burn-dominates-queue ordering) is unit-testable with plain tuples
   longer ``down_cooldown_s`` since any previous action;
 * **burn dominates queue** — a hot burn rate scales up even over an
   empty queue (latency is the SLO, queue depth is only a proxy), and
-  a warm burn rate vetoes scale-down no matter how idle the queue.
+  a warm burn rate vetoes scale-down no matter how idle the queue;
+* **predictive slope** (opt-in, ``HPNN_FLEET_UP_SLOPE``) — a steep
+  offered-load ramp (least-squares fit of outstanding-per-worker over
+  the trailing ``slope_for_s`` window) scales up *before* any level
+  threshold trips, buying the spawn latency back from the tail.
 
 Actions emit ``fleet.scale_up`` / ``fleet.scale_down`` carrying the
 triggering signal snapshot — and every record lands in the flight ring
@@ -58,12 +62,18 @@ class Policy:
     up_cooldown_s: float = 3.0
     down_cooldown_s: float = 15.0
     down_for_s: float = 5.0        # calm must be sustained this long
+    up_slope: float = 0.0          # predictive trigger: offered-load
+                                   # ramp (rows/worker per second) that
+                                   # scales up BEFORE burn; 0 disables
+    slope_for_s: float = 3.0       # trailing window the ramp is fit on
 
     def __post_init__(self):
         if not 1 <= self.min_width <= self.max_width:
             raise ValueError("need 1 <= min_width <= max_width")
         if self.up_step < 1 or self.down_step < 1:
             raise ValueError("steps must be >= 1")
+        if self.up_slope < 0 or self.slope_for_s <= 0:
+            raise ValueError("need up_slope >= 0 and slope_for_s > 0")
 
     # env knob -> field; the names docs/serving.md tabulates
     _ENV_FIELDS = (
@@ -78,6 +88,8 @@ class Policy:
         ("HPNN_FLEET_UP_COOLDOWN_S", "up_cooldown_s", float),
         ("HPNN_FLEET_DOWN_COOLDOWN_S", "down_cooldown_s", float),
         ("HPNN_FLEET_DOWN_FOR_S", "down_for_s", float),
+        ("HPNN_FLEET_UP_SLOPE", "up_slope", float),
+        ("HPNN_FLEET_SLOPE_FOR_S", "slope_for_s", float),
     )
 
     @classmethod
@@ -105,6 +117,18 @@ def _sample_field(sample, key: str, index: int):
     if isinstance(sample, dict):
         return sample.get(key)
     return sample[index]
+
+
+def _slope(points) -> float:
+    """Least-squares dy/dt over ``(t, y)`` pairs (0.0 when the fit is
+    degenerate) — the predictive trigger's ramp estimate."""
+    n = len(points)
+    mt = sum(t for t, _ in points) / n
+    my = sum(y for _, y in points) / n
+    den = sum((t - mt) ** 2 for t, _ in points)
+    if den <= 0.0:
+        return 0.0
+    return sum((t - mt) * (y - my) for t, y in points) / den
 
 
 def decide(samples, *, width: int, policy: Policy, now: float,
@@ -143,6 +167,19 @@ def decide(samples, *, width: int, policy: Policy, now: float,
         # bound, a ramp's sheds would pin the fleet wide for the whole
         # kept-sample horizon (~30 s) after traffic stops
         reason = "shed"
+    elif policy.up_slope > 0:
+        # predictive trigger: a steep offered-load ramp scales up
+        # BEFORE any level threshold trips — by the time burn or queue
+        # fire, up_cooldown_s + worker spawn latency are already in
+        # the tail.  The ramp is a least-squares fit over the trailing
+        # slope_for_s window; it needs >= 3 points spanning at least
+        # half the window, or one noisy pair would whipsaw the fleet.
+        pts = [(t, o) for (t, o, _s, _b) in rows
+               if t >= now - policy.slope_for_s]
+        if (len(pts) >= 3
+                and pts[-1][0] - pts[0][0] >= policy.slope_for_s / 2.0
+                and _slope(pts) >= policy.up_slope):
+            reason = "slope"
     if reason is not None:
         if width >= policy.max_width:
             return width, f"{reason}_at_max"
@@ -245,6 +282,47 @@ class Autoscaler:
                       shed=shed_delta,
                       burn=None if burn is None else round(burn, 4))
         return self.supervisor.width(), reason
+
+    # --------------------------------------------------------- requests
+    def request_up(self, *, reason: str) -> tuple[int, int] | None:
+        """Externally requested one-step scale-up (the tune plane's
+        queue remediation, hpnn_tpu/tune/engine.py): grow by
+        ``up_step`` under the policy's max clamp, emitting the same
+        audited ``fleet.scale_up`` record the loop's own decisions
+        emit.  Starts the up-cooldown, so the loop and the requester
+        never double-fire.  Returns ``(from_width, to_width)``, or
+        None when already at max."""
+        now = self._clock()
+        width = self.supervisor.width()
+        desired = min(self.policy.max_width, width + self.policy.up_step)
+        if desired <= width:
+            return None
+        for _ in range(desired - width):
+            self.supervisor.spawn()
+        self._last_up_t = now
+        obs.event("fleet.scale_up", from_width=width,
+                  to_width=desired, reason=reason)
+        return width, desired
+
+    def request_down(self, to_width: int, *,
+                     reason: str) -> tuple[int, int] | None:
+        """Externally requested shrink back to ``to_width`` (the tune
+        plane's rollback restoring the pre-apply width).  Clamped to
+        the policy min; drains the highest ranks first like the
+        loop's own scale-down.  Returns ``(from_width, to_width)``,
+        or None when no shrink applies."""
+        now = self._clock()
+        width = self.supervisor.width()
+        desired = max(self.policy.min_width, int(to_width))
+        if desired >= width:
+            return None
+        for rank in sorted(self.supervisor.ranks(),
+                           reverse=True)[:width - desired]:
+            self.supervisor.drain_and_kill(rank)
+        self._last_down_t = now
+        obs.event("fleet.scale_down", from_width=width,
+                  to_width=desired, reason=reason)
+        return width, desired
 
     # ------------------------------------------------------------- loop
     def start(self) -> None:

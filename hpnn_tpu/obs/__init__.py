@@ -78,7 +78,16 @@ structured side channel next to it:
   collector, and a ``meter.json`` capsule artifact —
   ``HPNN_METER`` / ``HPNN_METER_TOPK`` (obs/meter.py; blame table:
   ``tools/tenant_report.py``; drill: ``tools/chaos_drill.py
-  --drill hog``).
+  --drill hog``);
+* online per-phase blame attribution: the tail_report classifier
+  (queue/dispatch/spill/shed_retry/other/gap, exclusive time) run
+  in-process over the forensics sampler's emitted roots — rolling
+  ``blame.*_pct`` gauges on ``/metrics``/``/healthz``, a
+  ``blame.json`` capsule artifact, and the sensor feeding the
+  self-tuning remediation plane (hpnn_tpu/tune/,
+  docs/selftuning.md) — ``HPNN_BLAME`` (obs/blame.py; offline twin:
+  ``tools/tail_report.py``; drill: ``tools/chaos_drill.py --drill
+  tune``).
 
 Typical instrumentation site::
 
@@ -95,8 +104,8 @@ discipline, swallowed exceptions): ``tools/hpnnlint``,
 docs/analysis.md.
 """
 
-from hpnn_tpu.obs import (alerts, collector, cost, device, drift,
-                          export, flight, forensics, ledger,
+from hpnn_tpu.obs import (alerts, blame, collector, cost, device,
+                          drift, export, flight, forensics, ledger,
                           lockwatch, meter, probes, propagate, slo,
                           spans, triggers)
 from hpnn_tpu.obs.profiler import annotate, step_annotation
@@ -122,6 +131,7 @@ __all__ = [
     "activate_memory",
     "alerts",
     "annotate",
+    "blame",
     "collector",
     "configure",
     "cost",

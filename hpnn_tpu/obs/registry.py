@@ -236,7 +236,9 @@ def _init():
                     or os.environ.get("HPNN_SAMPLE")
                     or os.environ.get("HPNN_CAPSULE_DIR")
                     or os.environ.get("HPNN_DRIFT")
-                    or os.environ.get("HPNN_METER")):
+                    or os.environ.get("HPNN_METER")
+                    or os.environ.get("HPNN_BLAME")
+                    or os.environ.get("HPNN_TUNE")):
                 _state = False
                 return False
             path = None
@@ -608,6 +610,7 @@ def _reset_for_tests() -> None:
                  "hpnn_tpu.obs.alerts", "hpnn_tpu.obs.lockwatch",
                  "hpnn_tpu.obs.forensics", "hpnn_tpu.obs.triggers",
                  "hpnn_tpu.obs.drift", "hpnn_tpu.obs.meter",
+                 "hpnn_tpu.obs.blame", "hpnn_tpu.tune.engine",
                  "hpnn_tpu.chaos", "hpnn_tpu.online.wal"):
         mod = sys.modules.get(name)
         if mod is not None:

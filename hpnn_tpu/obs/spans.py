@@ -59,7 +59,7 @@ import os
 import threading
 import time
 
-from hpnn_tpu.obs import registry
+from hpnn_tpu.obs import blame, registry
 
 ENV_KNOB = "HPNN_SPANS"
 
@@ -191,6 +191,10 @@ def finish(sp, **fields) -> None:
     rec.update(sp.fields)
     rec.update(fields)
     registry._emit(st, rec)
+    # online blame tap (obs/blame.py): a memoized no-op unless
+    # HPNN_BLAME is armed — descendants buffer, a closing request
+    # root folds its per-phase split into the rolling window
+    blame.note_record(rec)
 
 
 def _reset_for_tests() -> None:

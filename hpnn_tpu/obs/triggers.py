@@ -207,7 +207,7 @@ def _assemble(path: str, reason: str, detail: dict | None,
                 flight_path = None
                 errors.append(f"flight.jsonl: {exc}")
 
-        from hpnn_tpu.obs import drift, export, forensics, meter
+        from hpnn_tpu.obs import blame, drift, export, forensics, meter
 
         spans = forensics.recent_spans()
         _write("spans.jsonl",
@@ -231,6 +231,13 @@ def _assemble(path: str, reason: str, detail: dict | None,
             # absent when HPNN_METER is unarmed)
             _write("meter.json",
                    json.dumps(attribution, indent=1, default=str))
+        phase_split = blame.sketch_doc()
+        if phase_split is not None:
+            # where the tail time was going when it fired: the rolling
+            # fleet + per-kernel phase-blame window (obs/blame.py;
+            # absent when HPNN_BLAME is unarmed)
+            _write("blame.json",
+                   json.dumps(phase_split, indent=1, default=str))
 
         profile = _profile_window(os.path.join(path, "profile"),
                                   cfg.get("profile_ms", 0.0))

@@ -48,6 +48,10 @@ the process boundary, and echo ``X-Trace-Id`` back.
   ``/v1/infer`` on such a host routes by the ``X-Tenant`` request
   header (absent → the default tenant) and a quota rejection's 429
   body carries ``reason="quota"`` plus the tenant name.
+* ``GET /tunez`` → the self-tuning plane's census — policy, applied/
+  rolled-back/vetoed counts, the armed watch, recent decision ledger
+  (hpnn_tpu/tune/; docs/selftuning.md); 404 when ``HPNN_TUNE`` is
+  unarmed.
 
 SIGTERM graceful drain: :func:`install_drain` chains a handler that
 stops admission (readiness flips, new arrivals get 503 +
@@ -74,7 +78,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from hpnn_tpu import obs
+from hpnn_tpu import obs, tune
 from hpnn_tpu.models import kernel as kernel_mod
 from hpnn_tpu.serve import compile_cache
 from hpnn_tpu.serve.batcher import (Batcher, DeadlineExceeded, QueueFull,
@@ -138,6 +142,15 @@ class Session:
         # so restart-under-traffic answers 503 instead of hanging
         self._ready = True
         self._ready_reason: str | None = None
+        # the self-tuning remediation plane (hpnn_tpu/tune/,
+        # docs/selftuning.md): a control loop over this session's
+        # registry/engine, started only when HPNN_TUNE is armed.
+        # Hosts that own an autoscaler or a quota enforcer wire them
+        # in by rebuilding: tune.for_session(self, autoscaler=...,
+        # quota=...).
+        self.tuner = tune.for_session(self)
+        if self.tuner is not None and self._start:
+            self.tuner.start()
 
     # ------------------------------------------------------------ kernels
     def load_kernel(self, name: str, path: str, *,
@@ -300,6 +313,10 @@ class Session:
         doc["sampler"] = obs.forensics.health_doc()
         doc["capsules"] = obs.triggers.health_doc()
         doc["drift"] = obs.drift.health_doc()
+        # the rolling per-phase blame split + the remediation plane's
+        # census (obs/blame.py, hpnn_tpu/tune/; docs/selftuning.md)
+        doc["blame"] = obs.blame.health_doc()
+        doc["tune"] = tune.health_doc()
         if self.online_health is not None:
             doc["online"] = self.online_health()
         return doc
@@ -398,6 +415,9 @@ class Session:
 
     # ------------------------------------------------------------ close
     def close(self):
+        if self.tuner is not None:
+            self.tuner.stop()
+            self.tuner = None
         with self._lock:
             self._closed = True
             batchers = list(self._batchers.values())
@@ -520,6 +540,15 @@ class _Handler(BaseHTTPRequestHandler):
             doc = obs.meter.meterz_doc()
             if doc is None:
                 self._reply(404, {"error": "meter not armed"})
+            else:
+                self._reply(200, doc)
+        elif self.path == "/tunez":
+            # the self-tuning plane's census (hpnn_tpu/tune/): policy,
+            # stats, armed watch, recent decision ledger; 404 when
+            # HPNN_TUNE is unarmed or no tuner is active
+            doc = tune.tunez_doc()
+            if doc is None:
+                self._reply(404, {"error": "tune not armed"})
             else:
                 self._reply(200, doc)
         elif self.path == "/metrics":

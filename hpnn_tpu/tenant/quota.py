@@ -261,6 +261,50 @@ class QuotaEnforcer:
             if st is not None and st.inflight > 0:
                 st.inflight -= 1
 
+    # ------------------------------------------------------------ pressure
+    def squeeze(self, factor: float) -> dict[str, TenantSpec]:
+        """Scale every *declared rate cap* by ``factor`` (< 1 =
+        pressure: overload is rejected at admission instead of after
+        queueing — the tune plane's shed-storm remediation,
+        hpnn_tpu/tune/engine.py).  Uncapped tenants are untouched (a
+        fraction of infinity is still infinity, and inventing a cap
+        is a policy decision this method must not take).  Returns the
+        displaced specs — the exact :class:`TenantSpec` tuples —
+        keyed by tenant, so :meth:`restore_specs` rolls the squeeze
+        back bitwise.  Empty when no tenant declares a rate."""
+        factor = float(factor)
+        if not factor > 0:
+            raise ValueError("squeeze factor must be > 0")
+        priors: dict[str, TenantSpec] = {}
+        with self._lock:
+            for tenant, spec in list(self._specs.items()):
+                if spec.rate_rps <= 0:
+                    continue
+                priors[tenant] = spec
+                new = spec._replace(rate_rps=spec.rate_rps * factor)
+                self._specs[tenant] = new
+                st = self._states.get(tenant)
+                if st is not None:
+                    st.spec = new
+                    # clamp banked burst to the new budget so a
+                    # squeeze takes effect now, not a burst later
+                    st.tokens = min(
+                        st.tokens,
+                        max(1.0, new.rate_rps * new.burst_s))
+        return priors
+
+    def restore_specs(self, priors: dict[str, TenantSpec]) -> None:
+        """Reinstall displaced specs from :meth:`squeeze` — the same
+        tuples, so the restored quota table is bitwise the
+        pre-squeeze one."""
+        with self._lock:
+            for tenant, spec in priors.items():
+                self._specs[tenant] = spec
+                st = self._states.get(tenant)
+                if st is not None:
+                    st.spec = spec
+        return None
+
     # ------------------------------------------------------------ outcomes
     @staticmethod
     def _shed_rate(st: _TenantState, now: float) -> float:

@@ -1,0 +1,289 @@
+"""Online per-phase blame attribution (obs/blame.py, ``HPNN_BLAME``)
+and its shared classifier core with tools/tail_report.py.
+
+The plane's contract: unset ⇒ one env read then constant-time no-ops;
+armed ⇒ every closing request root folds the same exclusive-time split
+the offline report computes into a rolling window, published as
+``blame.*_pct`` gauges and served to the tune engine as
+:func:`fleet_doc`.  The golden pin below holds the tail_report
+refactor behavior-identical, and the agreement test holds the online
+and offline splits within 1pp per phase on the same traffic."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import blame, triggers
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(path):
+    if not os.path.exists(path):
+        return []                # sink lazily created on first record
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _arm(monkeypatch, tmp_path, **env):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    for key, val in env.items():
+        monkeypatch.setenv(key, str(val))
+    obs._reset_for_tests()
+    return tmp_path / "m.jsonl"
+
+
+# One fixed request tree, used by the split/golden/online tests alike:
+# root 1.0s; queue 0.25; dispatch 0.40 with a nested 0.10 spill (so
+# its exclusive time is 0.30); a failed dispatch attempt 0.05 (the
+# shed marker must win over the name); gap = the uncovered 0.30.
+_TREE = [
+    {"span": 2, "parent": 1, "name": "serve.batch.queue",
+     "t0": 0.0, "dt": 0.25},
+    {"span": 4, "parent": 3, "name": "serve.spill_reload",
+     "t0": 0.0, "dt": 0.10},
+    {"span": 3, "parent": 1, "name": "serve.dispatch",
+     "t0": 0.0, "dt": 0.40},
+    {"span": 5, "parent": 1, "name": "serve.dispatch",
+     "t0": 0.0, "dt": 0.05, "failed": "Shed"},
+    {"span": 1, "parent": None, "name": "serve.request",
+     "t0": 0.0, "dt": 1.0, "req_id": "r1", "kernel": "k"},
+]
+
+_TREE_PCT = {"queue": 25.0, "dispatch": 30.0, "spill": 10.0,
+             "shed_retry": 5.0, "other": 0.0, "gap": 30.0}
+
+
+def _feed(records, base=0):
+    """Feed one raw tree through the online tap, refs offset so
+    repeated trees never collide (children before root, as the span
+    lifecycle guarantees)."""
+    for rec in records:
+        rec = dict(rec)
+        rec["span"] += base
+        if rec["parent"] is not None:
+            rec["parent"] += base
+        blame.note_record(rec)
+
+
+# ----------------------------------------------------------- pure core
+@pytest.mark.parametrize("name,failed,want", [
+    ("serve.batch.queue", None, "queue"),
+    ("cluster.queue.wait", None, "queue"),
+    ("serve.dispatch", None, "dispatch"),
+    ("serve.spill_reload", None, "spill"),
+    ("serve.dispatch", "Shed", "shed_retry"),       # shed wins
+    ("serve.batch.queue", "QueueFull", "shed_retry"),
+    ("serve.encode", "ValueError", "other"),        # not a shed fail
+    ("serve.encode", None, "other"),
+    (None, None, "other"),
+])
+def test_phase_of_classification(name, failed, want):
+    fields = {} if failed is None else {"failed": failed}
+    assert blame.phase_of({"name": name, "fields": fields}) == want
+
+
+def test_normalize_record_splits_structure_from_fields():
+    norm = blame.normalize_record(
+        {"ev": "span.end", "kind": "span", "span": 7, "parent": 3,
+         "name": "serve.dispatch", "t0": 1.0, "dt": "0.5", "ts": 2.0,
+         "kernel": "k", "failed": "Shed"})
+    assert norm["ref"] == 7 and norm["parent_ref"] == 3
+    assert norm["name"] == "serve.dispatch"
+    assert norm["dt"] == 0.5
+    assert norm["fields"] == {"kernel": "k", "failed": "Shed"}
+    # a torn record still normalizes (dt None -> 0.0)
+    assert blame.normalize_record({})["dt"] == 0.0
+
+
+def test_split_charges_exclusive_time_and_gap():
+    spans = [blame.normalize_record(r) for r in _TREE]
+    roots = blame.request_roots(spans)
+    assert len(roots) == 1
+    phases = blame.split(roots[0], blame.index_children(spans))
+    assert phases["queue"] == pytest.approx(0.25)
+    assert phases["dispatch"] == pytest.approx(0.30)   # 0.40 - 0.10
+    assert phases["spill"] == pytest.approx(0.10)
+    assert phases["shed_retry"] == pytest.approx(0.05)
+    assert phases["other"] == 0.0
+    assert phases["gap"] == pytest.approx(0.30)
+    assert sum(phases.values()) == pytest.approx(1.0)
+
+
+def test_nested_root_blames_into_parent_not_table():
+    """A serve.request under a cluster.request is a descendant, not a
+    second table row."""
+    spans = [blame.normalize_record(r) for r in [
+        {"span": 2, "parent": 1, "name": "serve.request",
+         "t0": 0.0, "dt": 0.4},
+        {"span": 1, "parent": None, "name": "cluster.request",
+         "t0": 0.0, "dt": 1.0},
+    ]]
+    roots = blame.request_roots(spans)
+    assert [r["name"] for r in roots] == ["cluster.request"]
+    phases = blame.split(roots[0], blame.index_children(spans))
+    assert phases["other"] == pytest.approx(0.4)
+    assert phases["gap"] == pytest.approx(0.6)
+
+
+def test_analyze_golden_pin():
+    """The full analyze() output over the fixed tree — the byte-level
+    contract tools/tail_report.py renders.  Loaded through the tool
+    (file-path core fallback included) so the refactor's import seam
+    is what's under test."""
+    tr = _load_tool("tail_report")
+    spans = [blame.normalize_record(r) for r in _TREE]
+    golden_phases = {"queue": 0.25, "dispatch": 0.3, "spill": 0.1,
+                     "shed_retry": 0.05, "other": 0.0, "gap": 0.3}
+    assert tr.analyze(spans, top=10) == {
+        "spans": 5,
+        "requests": 1,
+        "slowest": [{
+            "name": "serve.request", "ref": 1, "dt": 1.0,
+            "req_id": "r1", "trace": None, "sampled": False,
+            "promoted": False, "failed": None,
+            "phases": golden_phases,
+        }],
+        "blame_total_s": golden_phases,
+        "blame_pct": _TREE_PCT,
+    }
+    # and the shared-core seam itself: one module, one classifier
+    assert tr.analyze is blame.analyze
+    assert tr.PHASES == blame.PHASES
+    assert tr.ROOT_NAMES == blame.ROOT_NAMES
+
+
+# ------------------------------------------------------- online engine
+def test_unarmed_everything_noops(monkeypatch):
+    monkeypatch.delenv("HPNN_BLAME", raising=False)
+    obs._reset_for_tests()
+    assert not blame.enabled()
+    _feed(_TREE)                            # constant-time drop
+    assert blame.fleet_doc() is None
+    assert blame.sketch_doc() is None
+    assert blame.health_doc() == {"armed": False}
+    blame.flush()                           # no raise, no publish
+    assert not blame._pending and not blame._window
+
+
+def test_online_fold_matches_offline_split(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path, HPNN_BLAME="1")
+    _feed(_TREE)
+    doc = blame.fleet_doc()
+    assert doc["roots"] == 1
+    assert doc["pct"] == _TREE_PCT
+    assert doc["total_s"]["queue"] == pytest.approx(0.25)
+    kern = blame.kernel_doc()
+    assert kern["k"]["roots"] == 1
+    assert kern["k"]["pct"]["dispatch"] == pytest.approx(30.0)
+    health = blame.health_doc()
+    assert health["armed"] and health["roots_seen"] == 1
+    assert health["pending_spans"] == 0     # subtree fully collected
+
+
+def test_window_evicts_oldest_roots(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path, HPNN_BLAME="1", HPNN_BLAME_WINDOW="16")
+    for i in range(20):
+        _feed(_TREE, base=i * 10)
+    doc = blame.fleet_doc()
+    assert doc["roots"] == 16               # not 20: evicted
+    assert doc["pct"] == _TREE_PCT          # identical trees: stable
+    assert blame.health_doc()["roots_seen"] == 20
+
+
+def test_window_floor_and_bad_knob(monkeypatch, tmp_path, capsys):
+    _arm(monkeypatch, tmp_path, HPNN_BLAME="1", HPNN_BLAME_WINDOW="2")
+    assert blame._config()["window"] == blame.WINDOW_FLOOR
+    _arm(monkeypatch, tmp_path, HPNN_BLAME="1",
+         HPNN_BLAME_WINDOW="lots")
+    assert blame._config()["window"] == blame.DEFAULT_WINDOW
+    assert "HPNN_BLAME_WINDOW" in capsys.readouterr().err
+
+
+def test_gauges_publish_on_stride_and_flush(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path, HPNN_BLAME="1")
+    for i in range(blame._STRIDE - 1):
+        _feed(_TREE, base=i * 10)
+    gauges = [r for r in _read(sink) if r.get("kind") == "gauge"
+              and str(r.get("ev", "")).startswith("blame.")]
+    assert not gauges                       # stride not yet elapsed
+    _feed(_TREE, base=1000)                 # the stride-th root
+    recs = [r for r in _read(sink) if r.get("kind") == "gauge"]
+    by_ev = {r["ev"]: r for r in recs if "kernel" not in r}
+    for phase, gname in blame.GAUGE_OF.items():
+        assert by_ev[gname]["value"] == pytest.approx(
+            _TREE_PCT[phase], abs=0.01)
+    assert by_ev["blame.window_roots"]["value"] == blame._STRIDE
+    # per-kernel rows ride the same names with a kernel field
+    kern_rows = [r for r in recs if r.get("kernel") == "k"]
+    assert kern_rows
+    # flush republished regardless of stride
+    blame.flush()
+    n_roots_rows = [r for r in _read(sink)
+                    if r.get("ev") == "blame.window_roots"]
+    assert len(n_roots_rows) == 2
+
+
+def test_capsule_carries_blame_json(monkeypatch, tmp_path):
+    capdir = tmp_path / "capsules"
+    _arm(monkeypatch, tmp_path, HPNN_BLAME="1", HPNN_SAMPLE="1",
+         HPNN_CAPSULE_DIR=str(capdir), HPNN_CAPSULE_PROFILE_MS="0",
+         HPNN_CAPSULE_COOLDOWN_S="0")
+    _feed(_TREE)
+    man = triggers.capture("unit")
+    assert man is not None and "blame.json" in man["files"]
+    with open(os.path.join(man["capsule"], "blame.json")) as fp:
+        doc = json.load(fp)
+    assert doc["roots"] == 1
+    assert doc["fleet_pct"]["dispatch"] == pytest.approx(30.0)
+    assert doc["kernels"]["k"]["roots"] == 1
+
+
+def test_orphan_spans_age_out_without_blaming(monkeypatch, tmp_path):
+    """A child whose root never closes (crashed request) must neither
+    leak the pending buffer nor contribute phase mass."""
+    _arm(monkeypatch, tmp_path, HPNN_BLAME="1")
+    cap = blame._PENDING_CAP
+    for i in range(cap + 50):
+        blame.note_record({"span": i + 10, "parent": None,
+                           "name": "serve.orphan", "t0": 0.0,
+                           "dt": 0.1})
+    assert len(blame._pending) == cap
+    assert blame.fleet_doc()["roots"] == 0
+
+
+# ---------------------------------------------- online/offline parity
+def test_online_offline_agreement_within_1pp(monkeypatch, tmp_path):
+    """The ISSUE's closing claim: sampled serve traffic through a real
+    Session, the rolling online split vs the offline tail_report over
+    the very same sink — every phase within 1pp."""
+    sink = _arm(monkeypatch, tmp_path, HPNN_SAMPLE="1", HPNN_BLAME="1",
+                HPNN_BLAME_WINDOW="128")
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=0.5)
+    sess.register_kernel("k", k)
+    for _ in range(24):
+        sess.infer("k", np.zeros(8))
+    sess.close()
+    online = blame.fleet_doc()
+    assert online["roots"] == 24
+    obs.configure(None)
+    tr = _load_tool("tail_report")
+    offline = tr.analyze(tr.load_spans([str(sink)]), top=5)
+    assert offline["requests"] == 24
+    for phase in blame.PHASES:
+        assert online["pct"][phase] == pytest.approx(
+            offline["blame_pct"][phase], abs=1.0), phase

@@ -180,6 +180,65 @@ def test_decide_edge_inputs():
     assert w == 3
 
 
+def _ramp(slope, n=4, t0=0.0, base=0.5):
+    """n samples climbing ``slope`` rows/worker/s, all below the
+    up_outstanding level threshold so only the slope trigger can
+    fire."""
+    return [(t0 + t, base + slope * t, 0.0, None) for t in range(n)]
+
+
+def test_decide_slope_fires_below_level_thresholds():
+    pol = _p(up_slope=1.0, slope_for_s=3.0)
+    # 2 rows/worker/s over 3 s: max outstanding 6.5 < up_outstanding=8
+    w, reason = decide(_ramp(2.0), width=1, policy=pol, now=3.0)
+    assert (w, reason) == (3, "slope")
+
+
+def test_decide_slope_disabled_by_default():
+    # default up_slope=0: the same ramp is steady state
+    w, reason = decide(_ramp(2.0), width=1, policy=_p(), now=3.0)
+    assert (w, reason) == (1, "steady")
+
+
+def test_decide_slope_needs_enough_covered_window():
+    pol = _p(up_slope=1.0, slope_for_s=3.0)
+    # two points can't prove a ramp, whatever their slope
+    w, reason = decide(_ramp(2.0, n=2, t0=2.0), width=1, policy=pol,
+                       now=3.0)
+    assert (w, reason) == (1, "steady")
+    # three points spanning under half the window prove nothing either
+    narrow = [(2.4, 0.5, 0.0, None), (2.7, 1.1, 0.0, None),
+              (3.0, 1.7, 0.0, None)]
+    assert decide(narrow, width=1, policy=pol,
+                  now=3.0) == (1, "steady")
+    # a sub-threshold ramp stays steady
+    w, reason = decide(_ramp(0.4), width=1, policy=pol, now=3.0)
+    assert (w, reason) == (1, "steady")
+
+
+def test_decide_slope_loses_to_level_triggers():
+    pol = _p(up_slope=0.1, slope_for_s=3.0)
+    # queue over the level threshold names the level, not the ramp
+    hot = [(t, 9.0 + t, 0.0, None) for t in range(4)]
+    _w, reason = decide(hot, width=1, policy=pol, now=3.0)
+    assert reason == "queue"
+    # burn still dominates everything
+    burning = [(t, 0.5 + 2.0 * t, 0.0, 2.0) for t in range(4)]
+    _w, reason = decide(burning, width=1, policy=pol, now=3.0)
+    assert reason == "burn"
+
+
+def test_slope_policy_from_env():
+    pol = Policy.from_env({"HPNN_FLEET_UP_SLOPE": "1.5",
+                           "HPNN_FLEET_SLOPE_FOR_S": "4"})
+    assert pol.up_slope == 1.5 and pol.slope_for_s == 4.0
+    assert Policy.from_env({}).up_slope == 0.0   # off by default
+    with pytest.raises(ValueError):
+        Policy.from_env({"HPNN_FLEET_UP_SLOPE": "-1"})
+    with pytest.raises(ValueError):
+        Policy.from_env({"HPNN_FLEET_SLOPE_FOR_S": "0"})
+
+
 # ============================================= control loop (no procs)
 class _FakeSupervisor:
     def __init__(self):
@@ -238,6 +297,45 @@ def test_autoscaler_loop_scales_up_then_down(tmp_path):
     assert len(downs) == 2
     assert [d["to_width"] for d in downs] == [2, 1]
     # the recorded window passes the --cluster schema lint
+    tool = _load_catalog_tool()
+    assert tool.lint_cluster(str(sink)) == []
+
+
+def test_autoscaler_request_up_down_external_pushes(tmp_path):
+    """The tune plane's surface: request_up grows one policy step
+    (arming the up-cooldown so the loop can't double-fire),
+    request_down shrinks back draining highest ranks first, and both
+    emit lint-clean fleet.scale_* records with the caller's reason."""
+    sup = _FakeSupervisor()
+    clock_now = [10.0]
+    scaler = Autoscaler(sup, router=None,
+                        policy=_p(max_width=3, up_step=1,
+                                  up_cooldown_s=5.0),
+                        signals=lambda: (20.0, 0.0, None),
+                        clock=lambda: clock_now[0])
+    sink = tmp_path / "push.jsonl"
+    obs.configure(str(sink))
+    try:
+        assert scaler.request_up(reason="tune:queue") == (1, 2)
+        assert sup.width() == 2
+        # the push armed the up-cooldown — the hot loop can't pile on
+        assert scaler.tick()[1] == "queue_cooldown"
+        assert scaler.request_up(reason="tune:queue") == (2, 3)
+        # clamped at max: no change, no event
+        assert scaler.request_up(reason="tune:queue") is None
+        assert scaler.request_down(1, reason="tune:rollback") == (3, 1)
+        assert sup.width() == 1 and sup.drained == [2, 1]
+        assert scaler.request_down(1, reason="tune:rollback") is None
+    finally:
+        obs.configure(None)
+    recs = _read_sink(sink)
+    ups = [r for r in recs if r["ev"] == "fleet.scale_up"]
+    downs = [r for r in recs if r["ev"] == "fleet.scale_down"]
+    assert [(u["from_width"], u["to_width"], u["reason"])
+            for u in ups] == [(1, 2, "tune:queue"),
+                              (2, 3, "tune:queue")]
+    assert [(d["from_width"], d["to_width"], d["reason"])
+            for d in downs] == [(3, 1, "tune:rollback")]
     tool = _load_catalog_tool()
     assert tool.lint_cluster(str(sink)) == []
 

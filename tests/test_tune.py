@@ -1,0 +1,499 @@
+"""The self-tuning remediation plane (hpnn_tpu/tune/, ``HPNN_TUNE``):
+the pure :func:`decide` verdict matrix, the env-twinned
+:class:`Policy`, every actuator's apply/veto/rollback, the bounded
+post-apply watch, the audit trail (ledger + ``tune.*`` events), and
+the ``--tune`` schema lint over a real armed run.
+
+The plane's own contract on top of the usual obs one: every applied
+move carries the prior it displaced (rollback restores it bitwise),
+one move per cooldown, and a verdict for every tick — including all
+the explicit do-nothing ones."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.models import kernel as kernel_mod
+from hpnn_tpu.obs import blame
+from hpnn_tpu.tenant.quota import QuotaEnforcer, TenantSpec
+from hpnn_tpu.tune import engine
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+P = engine.Policy
+
+
+def _read(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as fp:
+        return [json.loads(ln) for ln in fp if ln.strip()]
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _arm(monkeypatch, tmp_path, **env):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.setenv("HPNN_BLAME", "1")
+    for key, val in env.items():
+        monkeypatch.setenv(key, str(val))
+    obs._reset_for_tests()
+    return tmp_path / "m.jsonl"
+
+
+def _sensor(phase="queue", pct=80.0, roots=32):
+    pcts = {p: 0.0 for p in blame.PHASES}
+    pcts[phase] = pct
+    pcts["gap"] = 100.0 - sum(v for k, v in pcts.items() if k != "gap")
+    return {"roots": roots, "pct": pcts}
+
+
+_CHILD_OF = {"queue": "serve.batch.queue", "dispatch": "serve.dispatch",
+             "spill": "serve.spill_reload", "shed_retry": "serve.retry"}
+
+
+def _feed_phase(phase, n=16, kernel="k"):
+    """n synthetic request roots whose tail is 90% one phase — the
+    online sensor reads dominant ``phase`` afterwards."""
+    refs = iter(range(1, 10 * n + 10, 2))
+    for _ in range(n):
+        child_ref, root_ref = next(refs), next(refs)
+        child = {"span": child_ref, "parent": root_ref,
+                 "name": _CHILD_OF[phase], "t0": 0.0, "dt": 0.9}
+        if phase == "shed_retry":
+            child["failed"] = "Shed"
+        blame.note_record(child)
+        blame.note_record({"span": root_ref, "parent": None,
+                           "name": "serve.request", "t0": 0.0,
+                           "dt": 1.0, "kernel": kernel})
+
+
+class _FakeScaler:
+    """request_up/request_down recorder standing in for
+    fleet/autoscaler.py (whose own push API has its own tests)."""
+
+    def __init__(self, to=(1, 2)):
+        self.ups, self.downs, self._to = [], [], to
+
+    def request_up(self, *, reason):
+        self.ups.append(reason)
+        return self._to
+
+    def request_down(self, to_width, *, reason):
+        self.downs.append((int(to_width), reason))
+        return (self._to[1] if self._to else 0, int(to_width))
+
+
+def _tuner(clock, p99, *, burn=3.0, policy=None, **kw):
+    return engine.Tuner(
+        kw.pop("session", None),
+        policy=policy if policy is not None else P(),
+        clock=lambda: clock["t"], p99_fn=lambda: p99["v"],
+        burn_fn=lambda: burn, **kw)
+
+
+# ------------------------------------------------------ decide() core
+def test_decide_no_sensor():
+    d = engine.decide(None, 3.0, policy=P(), now=0.0)
+    assert d["verdict"] == "no_sensor" and d["action"] is None
+
+
+def test_decide_watch_active_blocks_everything():
+    d = engine.decide(_sensor(roots=4), 3.0, policy=P(), now=0.0,
+                      watch_active=True)
+    assert d["verdict"] == "watch_active"
+
+
+def test_decide_thin_window():
+    d = engine.decide(_sensor(roots=15), 3.0, policy=P(), now=0.0)
+    assert d["verdict"] == "thin_window"
+
+
+@pytest.mark.parametrize("burn", [None, 0.0, 0.99])
+def test_decide_burn_ok_when_slo_healthy(burn):
+    d = engine.decide(_sensor(), burn, policy=P(), now=0.0)
+    assert d["verdict"] == "burn_ok" and d["action"] is None
+
+
+def test_decide_no_dominant():
+    d = engine.decide(_sensor(pct=39.9), 3.0, policy=P(), now=0.0)
+    assert d["verdict"] == "no_dominant" and d["phase"] == "queue"
+
+
+def test_decide_cooldown():
+    d = engine.decide(_sensor(), 3.0, policy=P(cooldown_s=30.0),
+                      now=100.0, last_apply_t=80.0)
+    assert d["verdict"] == "cooldown"
+    d = engine.decide(_sensor(), 3.0, policy=P(cooldown_s=30.0),
+                      now=120.0, last_apply_t=80.0)
+    assert d["verdict"] == "apply"
+
+
+@pytest.mark.parametrize("phase,action", list(engine.RULE_OF.items()))
+def test_decide_apply_maps_phase_to_knob(phase, action):
+    d = engine.decide(_sensor(phase), 3.0, policy=P(), now=0.0)
+    assert d["verdict"] == "apply"
+    assert d["phase"] == phase and d["action"] == action
+
+
+def test_decide_ignores_unactionable_phases():
+    """gap/other can dwarf everything — they have no knob, so the
+    dominant ACTIONABLE phase names the action."""
+    sensor = {"roots": 32, "pct": {"queue": 45.0, "dispatch": 1.0,
+                                   "spill": 0.0, "shed_retry": 0.0,
+                                   "other": 0.0, "gap": 54.0}}
+    d = engine.decide(sensor, 3.0, policy=P(), now=0.0)
+    assert d["verdict"] == "apply" and d["action"] == "scale_up"
+
+
+# --------------------------------------------------------------- policy
+def test_policy_from_env_parses_all_knobs():
+    pol = P.from_env({"HPNN_TUNE_DOMINANT_PCT": "55",
+                      "HPNN_TUNE_BURN": "2.5",
+                      "HPNN_TUNE_COOLDOWN_S": "7",
+                      "HPNN_TUNE_WATCH_S": "3",
+                      "HPNN_TUNE_QUANT_ERR": "1e-3",
+                      "HPNN_TUNE_DRY": "1"})
+    assert pol.dominant_pct == 55.0 and pol.burn_gate == 2.5
+    assert pol.cooldown_s == 7.0 and pol.watch_s == 3.0
+    assert pol.quant_err_max == 1e-3 and pol.dry is True
+    assert P.from_env({}) == P()
+    assert P.from_env({"HPNN_TUNE_BURN": "9"},
+                      burn_gate=1.5).burn_gate == 1.5  # overrides win
+
+
+def test_policy_from_env_rejects_junk():
+    with pytest.raises(ValueError, match="HPNN_TUNE_COOLDOWN_S"):
+        P.from_env({"HPNN_TUNE_COOLDOWN_S": "soon"})
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        P(dominant_pct=0.0)
+    with pytest.raises(ValueError):
+        P(cooldown_s=-1.0)
+
+
+# ------------------------------------------------------ tick + actuate
+def test_tick_no_sensor_when_blame_unarmed(monkeypatch, tmp_path):
+    monkeypatch.setenv("HPNN_METRICS", str(tmp_path / "m.jsonl"))
+    monkeypatch.delenv("HPNN_BLAME", raising=False)
+    obs._reset_for_tests()
+    t = _tuner({"t": 0.0}, {"v": 1.0}, autoscaler=_FakeScaler())
+    assert t.tick()["verdict"] == "no_sensor"
+
+
+def test_tick_applies_scale_up_and_audits(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    clock, p99 = {"t": 100.0}, {"v": 50.0}
+    scaler = _FakeScaler(to=(1, 2))
+    t = _tuner(clock, p99, autoscaler=scaler)
+    d = t.tick()
+    assert d["verdict"] == "apply" and d["action"] == "scale_up"
+    assert d["id"] == "t1" and d["applied"] == 2
+    assert scaler.ups == ["tune:queue"]
+    assert t.stats["applied"] == 1
+    (ap,) = [r for r in _read(sink) if r["ev"] == "tune.apply"]
+    assert ap["id"] == "t1" and ap["phase"] == "queue"
+    assert ap["prior"] == 1 and ap["applied"] == 2
+    assert ap["pct"] == pytest.approx(90.0)
+    assert ap["cooldown_s"] == t.policy.cooldown_s
+    # a second tick inside the watch: one change at a time
+    assert t.tick()["verdict"] == "watch_active"
+    assert t.census()["watch"]["id"] == "t1"
+
+
+def test_watch_passes_then_cooldown_holds(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    clock, p99 = {"t": 100.0}, {"v": 50.0}
+    t = _tuner(clock, p99, autoscaler=_FakeScaler(),
+               policy=P(cooldown_s=30.0, watch_s=10.0))
+    assert t.tick()["verdict"] == "apply"
+    clock["t"] += 10.1                      # survive the watch...
+    d = t.tick()
+    assert d["verdict"] == "cooldown"       # ...but the cooldown holds
+    assert any(r["verdict"] == "watch_pass" and r["id"] == "t1"
+               for r in t.census()["ledger"])
+    assert t.census()["watch"] is None
+    clock["t"] += 30.0                      # cooldown over: re-apply
+    assert t.tick()["verdict"] == "apply"
+
+
+def test_watch_regression_rolls_back(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    clock, p99 = {"t": 100.0}, {"v": 50.0}
+    scaler = _FakeScaler(to=(1, 2))
+    t = _tuner(clock, p99, autoscaler=scaler,
+               policy=P(cooldown_s=30.0, watch_s=10.0))
+    assert t.tick()["verdict"] == "apply"
+    clock["t"] += 5.0
+    p99["v"] = 50.0 * engine.ROLLBACK_P99_RATIO + 1.0
+    assert t.check_watch() == "scale_up"
+    assert scaler.downs == [(1, "tune:rollback")]
+    assert t.stats["rolled_back"] == 1
+    (rb,) = [r for r in _read(sink) if r["ev"] == "tune.rollback"]
+    assert rb["id"] == "t1" and rb["restored"] == 1
+    assert rb["reason"] == "p99_regression"
+    # the rollback is itself a move: the cooldown re-armed
+    assert t.tick()["verdict"] == "cooldown"
+    assert t.rollback("again") is None      # nothing watched now
+
+
+def test_veto_lands_in_ledger_not_apply(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    t = _tuner({"t": 0.0}, {"v": 1.0}, autoscaler=_FakeScaler(to=None))
+    d = t.tick()
+    assert d["verdict"] == "veto" and d["reason"] == "at_max"
+    assert t.stats["vetoed"] == 1 and t.census()["watch"] is None
+    recs = _read(sink)
+    assert not [r for r in recs if r["ev"] == "tune.apply"]
+    (dec,) = [r for r in recs if r["ev"] == "tune.decision"]
+    assert dec["verdict"] == "veto" and dec["reason"] == "at_max"
+
+
+def test_dry_run_decides_but_never_actuates(monkeypatch, tmp_path):
+    sink = _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    scaler = _FakeScaler()
+    t = _tuner({"t": 0.0}, {"v": 1.0}, autoscaler=scaler,
+               policy=P(dry=True))
+    assert t.tick()["verdict"] == "dry_run"
+    assert not scaler.ups and t.stats["applied"] == 0
+    assert not [r for r in _read(sink) if r["ev"] == "tune.apply"]
+
+
+def test_no_actuator_when_knob_not_wired(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    t = _tuner({"t": 0.0}, {"v": 1.0})      # no autoscaler wired
+    assert t.tick()["verdict"] == "no_actuator"
+
+
+def test_quota_squeeze_applies_and_restores_bitwise(monkeypatch,
+                                                    tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("shed_retry")
+    quota = QuotaEnforcer({"bronze": TenantSpec("bronze", "bronze",
+                                                rate_rps=40.0)})
+    before = quota.spec("bronze")
+    t = _tuner({"t": 0.0}, {"v": 1.0}, quota=quota)
+    d = t.tick()
+    assert d["verdict"] == "apply" and d["action"] == "quota_squeeze"
+    assert quota.spec("bronze").rate_rps == pytest.approx(
+        40.0 * engine.QUOTA_SQUEEZE_FACTOR)
+    assert t.rollback("unit") == "quota_squeeze"
+    assert quota.spec("bronze") == before   # the exact tuple
+
+
+def test_quota_squeeze_vetoes_without_rate_caps(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("shed_retry")
+    t = _tuner({"t": 0.0}, {"v": 1.0}, quota=QuotaEnforcer({}))
+    d = t.tick()
+    assert d["verdict"] == "veto" and d["reason"] == "no_rate_caps"
+
+
+def _session(mode="compiled"):
+    sess = serve.Session(max_batch=8, n_buckets=2, max_wait_ms=0.5,
+                         mode=mode)
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    sess.register_kernel("k", k)
+    return sess
+
+
+def test_grow_buckets_applies_and_restores(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("spill")
+    sess = _session()
+    try:
+        prior = tuple(sess.engine.buckets)
+        t = _tuner({"t": 0.0}, {"v": 1.0}, session=sess)
+        d = t.tick()
+        assert d["verdict"] == "apply" and d["action"] == "grow_buckets"
+        assert len(sess.engine.buckets) == len(prior) + 1
+        assert t.rollback("unit") == "grow_buckets"
+        assert tuple(sess.engine.buckets) == prior
+    finally:
+        sess.close()
+
+
+def test_precision_down_applies_one_notch(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("dispatch")
+    sess = _session()
+    try:
+        v0 = sess.registry.get("k").version
+        t = _tuner({"t": 0.0}, {"v": 1.0}, session=sess)
+        d = t.tick()
+        assert d["verdict"] == "apply"
+        assert d["action"] == "precision_down" and d["target"] == "k"
+        entry = sess.registry.get("k")
+        assert entry.precision == engine.DOWNSHIFT[
+            sess.engine.default_precision or "native"]
+        assert entry.version > v0           # a retag is a new version
+        assert t.rollback("unit") == "precision_down"
+        assert sess.registry.get("k").precision is None
+    finally:
+        sess.close()
+
+
+def test_precision_down_vetoes_in_parity_mode(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("dispatch")
+    sess = _session(mode="parity")
+    try:
+        t = _tuner({"t": 0.0}, {"v": 1.0}, session=sess)
+        d = t.tick()
+        assert d["verdict"] == "veto" and d["reason"] == "parity_mode"
+    finally:
+        sess.close()
+
+
+def test_precision_down_vetoes_at_floor(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("dispatch")
+    sess = _session()
+    try:
+        sess.registry.set_precision("k", "bf16")  # already at floor
+        t = _tuner({"t": 0.0}, {"v": 1.0}, session=sess)
+        d = t.tick()
+        assert d["verdict"] == "veto" and d["reason"] == "at_floor"
+        assert sess.registry.get("k").precision == "bf16"
+    finally:
+        sess.close()
+
+
+def test_precision_down_vetoes_on_quant_err_and_reverts(monkeypatch,
+                                                        tmp_path):
+    """A downshift whose MEASURED error breaches the bound reverts
+    immediately — and the revert is a fresh version, never a reuse."""
+    _arm(monkeypatch, tmp_path)
+    _feed_phase("dispatch")
+    sess = _session()
+
+    class _BigErr(dict):
+        def get(self, key, default=None):
+            return 1.0                      # any bound is breached
+
+    try:
+        monkeypatch.setattr(sess.engine, "_quant_err", _BigErr())
+        v0 = sess.registry.get("k").version
+        t = _tuner({"t": 0.0}, {"v": 1.0}, session=sess)
+        d = t.tick()
+        assert d["verdict"] == "veto" and d["reason"] == "quant_err"
+        entry = sess.registry.get("k")
+        assert entry.precision is None      # displaced policy restored
+        assert entry.version == v0 + 2      # downshift + revert
+    finally:
+        sess.close()
+
+
+# --------------------------------------------------------------- audit
+def test_decision_stream_records_edges_not_steady_state(monkeypatch,
+                                                        tmp_path):
+    sink = _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    t = _tuner({"t": 0.0}, {"v": 1.0}, burn=0.0)
+    for _ in range(5):
+        assert t.tick()["verdict"] == "burn_ok"
+    decisions = [r for r in _read(sink) if r["ev"] == "tune.decision"]
+    assert len(decisions) == 1              # the edge, not the hour
+    assert t.stats["ticks"] == 5
+
+
+def test_for_session_and_census_docs(monkeypatch, tmp_path):
+    monkeypatch.delenv("HPNN_TUNE", raising=False)
+    obs._reset_for_tests()
+    assert engine.for_session(object()) is None
+    assert engine.tunez_doc() is None
+    assert engine.health_doc() == {"armed": False}
+    monkeypatch.setenv("HPNN_TUNE", "1")
+    _arm(monkeypatch, tmp_path)
+    t = engine.for_session(object(), autoscaler=_FakeScaler())
+    assert t is not None
+    assert sorted(t._actuators) == ["grow_buckets", "precision_down",
+                                    "scale_up"]
+    t.activate()
+    doc = engine.tunez_doc()
+    assert doc["armed"] and doc["rules"] == engine.RULE_OF
+    assert doc["policy"]["dominant_pct"] == t.policy.dominant_pct
+    health = engine.health_doc()
+    assert health["armed"] and health["active"]
+    assert "ledger" not in health           # /tunez carries the ledger
+    t.stop()
+    assert engine.tunez_doc() is None
+
+
+# ---------------------------------------------------------------- lint
+def _tune_sink(monkeypatch, tmp_path):
+    """A real armed run: one apply, one regression rollback, decision
+    edges — the accept fixture for lint_tune."""
+    sink = _arm(monkeypatch, tmp_path)
+    _feed_phase("queue")
+    blame.flush()
+    clock, p99 = {"t": 100.0}, {"v": 50.0}
+    t = _tuner(clock, p99, autoscaler=_FakeScaler(),
+               policy=P(cooldown_s=30.0, watch_s=10.0))
+    assert t.tick()["verdict"] == "apply"
+    clock["t"] += 5.0
+    p99["v"] = 500.0
+    assert t.check_watch() == "scale_up"
+    obs.configure(None)
+    return sink
+
+
+def test_lint_tune_accepts_a_real_run(monkeypatch, tmp_path):
+    sink = _tune_sink(monkeypatch, tmp_path)
+    lint = _load_tool("check_obs_catalog")
+    assert lint.lint_tune(str(sink)) == []
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ({"ev": "tune.apply", "id": "", "action": "scale_up",
+      "phase": "queue", "pct": 50.0, "prior": 1, "applied": 2,
+      "cooldown_s": 1.0, "watch_s": 1.0}, "non-empty"),
+    ({"ev": "tune.apply", "id": "tx", "action": "overclock",
+      "phase": "queue", "pct": 50.0, "prior": 1, "applied": 2,
+      "cooldown_s": 1.0, "watch_s": 1.0}, "action"),
+    ({"ev": "tune.apply", "id": "ty", "action": "scale_up",
+      "phase": "queue", "pct": 150.0, "applied": 2,
+      "cooldown_s": 1.0, "watch_s": 1.0}, "prior"),
+    ({"ev": "tune.rollback", "id": "never-applied",
+      "action": "scale_up", "reason": "x", "restored": 1},
+     "pairs no"),
+    ({"ev": "tune.decision", "verdict": "vibes", "roots": 1},
+     "closed enum"),
+    ({"ev": "tune.decision", "verdict": "apply", "roots": -1},
+     "roots"),
+    ({"ev": "blame.queue_pct", "kind": "gauge", "value": 120.0},
+     "[0, 100]"),
+    ({"ev": "blame.window_roots", "kind": "gauge", "value": -3},
+     "non-negative"),
+])
+def test_lint_tune_break_ladder(monkeypatch, tmp_path, bad, needle):
+    sink = _tune_sink(monkeypatch, tmp_path)
+    with open(sink, "a") as fp:
+        fp.write(json.dumps(bad) + "\n")
+    lint = _load_tool("check_obs_catalog")
+    failures = lint.lint_tune(str(sink))
+    assert failures and any(needle in f for f in failures), failures
+
+
+def test_lint_tune_wants_an_armed_run(tmp_path):
+    quiet = tmp_path / "quiet.jsonl"
+    quiet.write_text('{"ev": "obs.open", "kind": "meta"}\n')
+    lint = _load_tool("check_obs_catalog")
+    assert any("HPNN_TUNE" in f for f in lint.lint_tune(str(quiet)))

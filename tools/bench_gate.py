@@ -183,6 +183,18 @@ GATE_METRICS = {
     "meter_overhead_pct": ("lower", 2.00),
     "drill_hog_blame_pct": ("higher", 0.30),
     "drill_hog_detect_s": ("lower", 1.50),
+    # self-tuning fold-ins (bench.py bench_blame_overhead +
+    # tools/chaos_drill.py run_bench_tune_drill; docs/selftuning.md):
+    # the paired marginal cost of the online blame classifier over a
+    # sampler-armed serve hot path (acceptance bar <=5% — medians
+    # hover near zero, so the tolerance is wide like the other
+    # overhead gates), the fraction of blame classes whose dominant
+    # window moved the MATCHING knob (acceptance floor 1.0 — every
+    # class must map to its remediation), and whether both deliberate
+    # bad moves restored the displaced config bitwise
+    "blame_overhead_pct": ("lower", 2.00),
+    "drill_tune_applies": ("higher", 0.01),
+    "drill_tune_rollback_bitwise": ("higher", 0.01),
 }
 
 

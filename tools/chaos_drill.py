@@ -87,15 +87,30 @@ catalog (docs/resilience.md):
   device-seconds, the shed-rate rule fires, and the alert-triggered
   capsule carries ``meter.json``.
 
+* **tune** — the self-tuning remediation plane's proof
+  (docs/selftuning.md): per blame class, a synthetic span stream
+  makes that class dominate the REAL online blame window
+  (obs/blame.py), and a scripted-clock/scripted-p99
+  :class:`~hpnn_tpu.tune.engine.Tuner` over real actuator targets
+  (a live compiled serve Session, an Autoscaler over an in-memory
+  supervisor, a QuotaEnforcer) must apply the MATCHING action —
+  ``tune.apply`` in the sink — and see the tail recover through its
+  watch window.  Two deliberately bad moves then prove rollback:
+  a p99 regression inside the watch and a direct bad-action
+  rollback, each restoring the displaced config bitwise (precision
+  version chain strictly monotone, quota specs tuple-identical),
+  with ``tools/check_obs_catalog.py --tune`` passing over the
+  drill's own sink.
+
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
 ``drill.reload`` | ``drill.sentinel`` | ``drill.replica`` |
 ``drill.alert`` | ``drill.worker`` | ``drill.capsule`` |
-``drill.drift`` | ``drill.quota`` | ``drill.hog``;
+``drill.drift`` | ``drill.quota`` | ``drill.hog`` | ``drill.tune``;
 :func:`run_bench_drill` /
 :func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` /
 :func:`run_bench_worker_drill` / :func:`run_bench_capsule_drill` /
 :func:`run_bench_drift_drill` / :func:`run_bench_quota_drill` /
-:func:`run_bench_hog_drill` are
+:func:`run_bench_hog_drill` / :func:`run_bench_tune_drill` are
 the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
 ``drill_replica_dip_pct`` / ``drill_replica_survivors_lost`` /
@@ -103,7 +118,8 @@ the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_worker_dip_pct`` / ``drill_worker_replaced_s`` /
 ``drill_capsule_capture_s`` / ``drill_capsule_blame_pct`` /
 ``drill_drift_detect_s`` / ``drill_quota_victim_goodput_ratio`` /
-``drill_hog_blame_pct`` / ``drill_hog_detect_s``, gated by
+``drill_hog_blame_pct`` / ``drill_hog_detect_s`` /
+``drill_tune_applies`` / ``drill_tune_rollback_bitwise``, gated by
 ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
@@ -1503,6 +1519,243 @@ def drill_hog(workdir: str, *, rate: float = 12.0, seed: int = 11,
                 os.environ[key] = val
 
 
+def drill_tune(workdir: str, *, rate: float = 0.0, seed: int = 13,
+               n_roots: int = 24) -> dict:
+    """The self-tuning plane's proof (docs/selftuning.md): drive the
+    REAL online blame engine with a synthetic span stream per blame
+    class, and a scripted-clock :class:`~hpnn_tpu.tune.engine.Tuner`
+    over real actuator targets must move the MATCHING knob, watch the
+    tail recover, and roll a bad move back bitwise.
+
+    Deterministic and in-process — no child, no wall-clock races
+    (``rate`` is accepted for :func:`run_drills` signature parity and
+    unused).  Per class: inject ``n_roots`` request roots whose
+    subtree charges ~90% of the root time to that class, tick, assert
+    ``tune.apply`` names ``RULE_OF[class]``, script the p99 down, and
+    let the watch expire clean (``watch_pass``).  Then two bad moves:
+    a second ``precision_down`` whose scripted p99 regresses past the
+    rollback ratio inside the watch (restore must be the prior
+    precision tag, registry version chain strictly monotone), and a
+    second ``quota_squeeze`` rolled back directly (restored spec must
+    be the exact pre-apply :class:`TenantSpec` tuple).  Finally
+    ``tools/check_obs_catalog.py --tune`` must pass over the drill's
+    own sink."""
+    import itertools
+
+    import check_obs_catalog
+
+    from hpnn_tpu import obs, serve, tune
+    from hpnn_tpu.fleet import autoscaler as autoscaler_mod
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.obs import blame
+    from hpnn_tpu.tenant.quota import QuotaEnforcer, TenantSpec
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.tune", "ok": False}
+    sink = os.path.join(workdir, "tune-drill.metrics.jsonl")
+    env_keys = ("HPNN_METRICS", "HPNN_BLAME", "HPNN_BLAME_WINDOW",
+                "HPNN_TUNE")
+    prev_env = {key: os.environ.get(key) for key in env_keys}
+
+    class _MemFleet:
+        """width()/ranks()/spawn()/drain_and_kill() in memory — the
+        request_up/request_down surface with no real processes."""
+
+        def __init__(self, width: int):
+            self._ranks = list(range(width))
+            self._next = width
+
+        def width(self):
+            return len(self._ranks)
+
+        def ranks(self):
+            return list(self._ranks)
+
+        def spawn(self):
+            self._ranks.append(self._next)
+            self._next += 1
+
+        def drain_and_kill(self, rank):
+            self._ranks.remove(rank)
+
+    ids = itertools.count(1)
+    child_name = {"queue": "serve.batch.queue",
+                  "dispatch": "serve.dispatch",
+                  "spill": "serve.spill_reload",
+                  "shed_retry": "serve.retry_wait"}
+
+    def inject(phase: str) -> None:
+        """``n_roots`` fresh request roots, ~90% of each charged to
+        ``phase`` — fed through the real ``note_record`` tap
+        (children close before their root, as in the span
+        lifecycle)."""
+        for _ in range(n_roots):
+            root = f"r{next(ids)}"
+            child = {"span": f"c{next(ids)}", "parent": root,
+                     "name": child_name[phase], "t0": 0.0, "dt": 0.9}
+            if phase == "shed_retry":
+                child["failed"] = "Shed"
+            blame.note_record(child)
+            blame.note_record({"span": root, "parent": None,
+                               "name": "serve.request", "t0": 0.0,
+                               "dt": 1.0, "kernel": KERNEL})
+
+    sess = None
+    try:
+        obs.configure(sink)
+        sess = serve.Session(max_batch=8, n_buckets=2,
+                             max_wait_ms=0.5, mode="compiled")
+        k, _ = kernel_mod.generate(seed, 8, [5], 2)
+        sess.register_kernel(KERNEL, k)
+        scaler = autoscaler_mod.Autoscaler(
+            _MemFleet(2), None,
+            policy=autoscaler_mod.Policy(min_width=1, max_width=4,
+                                         up_step=1))
+        quota = QuotaEnforcer(
+            {"bronze": TenantSpec("bronze", "bronze", rate_rps=40.0)})
+        policy = tune.Policy(cooldown_s=5.0, watch_s=2.0)
+        clock = {"t": 1000.0}
+        p99 = {"v": 100.0}
+
+        def fresh_tuner():
+            return tune.Tuner(
+                sess, autoscaler=scaler, quota=quota, policy=policy,
+                clock=lambda: clock["t"], p99_fn=lambda: p99["v"],
+                burn_fn=lambda: 3.0)
+
+        def one_round(phase: str, *, regress: bool) -> dict:
+            """inject → tick → scripted watch; returns the round's
+            verdict/action plus what check_watch did."""
+            blame.configure("1", window=16)  # fresh window per class
+            inject(phase)
+            p99["v"] = 100.0
+            tuner = fresh_tuner()
+            t_apply = clock["t"]
+            d = tuner.tick()
+            if regress:
+                clock["t"] = t_apply + policy.watch_s / 2
+                p99["v"] = 300.0  # past before * 1.25 inside watch
+            else:
+                clock["t"] = t_apply + policy.watch_s + 0.1
+                p99["v"] = 40.0   # recovered: watch expires clean
+            rolled = tuner.check_watch()
+            return {"verdict": d.get("verdict"),
+                    "action": d.get("action"), "id": d.get("id"),
+                    "rolled_back": rolled, "tuner": tuner}
+
+        from hpnn_tpu.tune.engine import RULE_OF
+
+        rounds: dict = {}
+        for phase in ("queue", "dispatch", "spill", "shed_retry"):
+            rounds[phase] = one_round(phase, regress=False)
+        width_after = scaler.supervisor.width()
+        buckets_after = tuple(sess.engine.buckets)
+        squeezed_rate = quota.spec("bronze").rate_rps
+
+        # bad move 1: a second precision notch (f32 -> bf16) whose
+        # scripted p99 regresses inside the watch -> rollback must
+        # restore the prior tag as a NEW version (chain monotone)
+        v_before_bad = sess.registry.get(KERNEL).version
+        prec_before_bad = sess.registry.get(KERNEL).precision
+        bad_prec = one_round("dispatch", regress=True)
+        ent = sess.registry.get(KERNEL)
+        prec_restored = ent.precision == prec_before_bad
+        versions_monotone = ent.version > v_before_bad
+
+        # bad move 2: a second quota squeeze rolled back directly (the
+        # bad-action path drills exercise) — the restored spec must be
+        # the exact pre-apply tuple
+        spec_before_bad = quota.spec("bronze")
+        blame.configure("1", window=16)
+        inject("shed_retry")
+        p99["v"] = 100.0
+        bad_quota_tuner = fresh_tuner()
+        bad_quota = bad_quota_tuner.tick()
+        bad_quota_rolled = bad_quota_tuner.rollback("drill_bad_action")
+        quota_restored = quota.spec("bronze") == spec_before_bad
+
+        blame.flush()
+        obs.configure(None)  # close the sink for a complete audit
+
+        events = []
+        with open(sink) as fp:
+            for line in fp:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        applies = [r for r in events if r.get("ev") == "tune.apply"]
+        rollbacks = [r for r in events
+                     if r.get("ev") == "tune.rollback"]
+        scale_ups = [r for r in events
+                     if r.get("ev") == "fleet.scale_up"
+                     and r.get("reason") == "tune:queue"]
+        # warmup re-emits the resident version; the chain claim is
+        # over the retags themselves (source=set): every move — the
+        # two downshifts AND the rollback's restore — a fresh version
+        prec_versions = [r.get("version") for r in events
+                         if r.get("ev") == "serve.precision"
+                         and r.get("source") == "set"]
+
+        matched = sum(
+            1 for phase in rounds
+            if rounds[phase]["verdict"] == "apply"
+            and rounds[phase]["action"] == RULE_OF[phase])
+        out["applies"] = round(matched / 4.0, 3)
+        out["actions"] = {p: rounds[p]["action"] for p in rounds}
+        out["recovered"] = sum(
+            1 for p in rounds if rounds[p]["rolled_back"] is None)
+        out["width_after"] = width_after
+        out["buckets_after"] = list(buckets_after)
+        out["squeezed_rate_rps"] = squeezed_rate
+        out["bad_prec_rolled_back"] = bad_prec["rolled_back"]
+        out["precision_restored_bitwise"] = prec_restored
+        out["version_chain_monotone"] = bool(
+            versions_monotone
+            and prec_versions == sorted(prec_versions)
+            and len(set(prec_versions)) == len(prec_versions))
+        out["bad_quota_rolled_back"] = bad_quota_rolled
+        out["quota_restored_bitwise"] = quota_restored
+        out["rollback_bitwise"] = (
+            1.0 if (prec_restored and quota_restored) else 0.0)
+        out["applies_in_sink"] = len(applies)
+        out["rollbacks_in_sink"] = len(rollbacks)
+        rollback_pairs_ok = (
+            len(rollbacks) == 2
+            and {r.get("id") for r in rollbacks}
+            <= {a.get("id") for a in applies}
+            and {r.get("reason") for r in rollbacks}
+            == {"p99_regression", "drill_bad_action"})
+        out["rollback_pairs_ok"] = rollback_pairs_ok
+        lint = check_obs_catalog.lint_tune(sink)
+        out["lint_failures"] = lint
+        out["ok"] = bool(
+            matched == 4
+            and out["recovered"] == 4
+            and width_after == 3            # scale_up: 2 -> 3
+            and len(buckets_after) == 3     # grow_buckets: 2 -> 3
+            and squeezed_rate == 20.0       # quota_squeeze: 40 -> 20
+            and bad_prec["rolled_back"] == "precision_down"
+            and bad_quota["verdict"] == "apply"
+            and bad_quota_rolled == "quota_squeeze"
+            and out["rollback_bitwise"] == 1.0
+            and out["version_chain_monotone"]
+            and scale_ups
+            and rollback_pairs_ok
+            and not lint)
+        return out
+    finally:
+        if sess is not None:
+            sess.close()
+        obs.configure(None)
+        blame.configure(None)
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
@@ -1514,6 +1767,7 @@ DRILLS = {
     "drift": drill_drift,
     "quota": drill_quota,
     "hog": drill_hog,
+    "tune": drill_tune,
 }
 
 
@@ -1687,6 +1941,29 @@ def run_bench_quota_drill(*, rate: float = 100.0) -> dict:
     return out
 
 
+def run_bench_tune_drill(*, rate: float = 0.0) -> dict:
+    """The bench.py fold-in for the tune drill: the self-tuning
+    plane's per-blame-class apply/recover/rollback proof, reported
+    as gateable numbers (``drill_tune_applies`` — the fraction of
+    blame classes whose dominant window moved the matching knob —
+    and ``drill_tune_rollback_bitwise`` — 1.0 when both bad moves
+    restored the displaced config bitwise)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_tune(tmp, rate=rate)
+    out = {
+        "metric": "tune_drill",
+        "drill": row,
+        "applies": row.get("applies"),
+        "rollback_bitwise": row.get("rollback_bitwise"),
+        "version_chain_monotone": row.get("version_chain_monotone"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 def run_bench_hog_drill(*, rate: float = 12.0) -> dict:
     """The bench.py fold-in for the hog drill: one tenant at 20x the
     zipf head's rate under an armed meter, reported as gateable
@@ -1715,11 +1992,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
                     "(kill9 / reload / sentinel / replica / alert / "
-                    "worker / capsule / drift / quota / hog)")
+                    "worker / capsule / drift / quota / hog / tune)")
     ap.add_argument("--drill", default="all",
                     choices=("all", "kill9", "reload", "sentinel",
                              "replica", "alert", "worker", "capsule",
-                             "drift", "quota", "hog"))
+                             "drift", "quota", "hog", "tune"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

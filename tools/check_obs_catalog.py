@@ -143,13 +143,23 @@ makes the "every column sums to the fleet total" contract a lie — so
 their shapes are frozen too (docs/observability.md "Tenant
 metering").
 
+And the self-tuning schema lint (:func:`lint_tune`): the
+``tune.apply`` / ``tune.rollback`` / ``tune.decision`` records
+(hpnn_tpu/tune/engine.py, HPNN_TUNE) are the audit trail of a plane
+that moves *production serving knobs on its own* — an apply outside
+the action enum, a rollback that pairs no apply id, a decision whose
+verdict is off the closed enum, or a blame-share gauge outside
+[0, 100] makes the "every autonomous change is attributable and
+reversible" claim unverifiable, so their shapes are frozen too
+(docs/selftuning.md).
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
         [--serve-replicas PATH] [--fleet PATH] [--cluster PATH]
         [--forensics PATH] [--drift PATH] [--tenant PATH]
-        [--meter PATH]
+        [--meter PATH] [--tune PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -178,7 +188,7 @@ DOC_RE = re.compile(
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
              "docs/fleet.md", "docs/online.md", "docs/resilience.md",
              "docs/performance.md", "docs/analysis.md",
-             "docs/tenancy.md")
+             "docs/tenancy.md", "docs/selftuning.md")
 SRC_DIR = "hpnn_tpu"
 
 
@@ -2165,6 +2175,175 @@ def lint_meter(path: str) -> list[str]:
     return failures
 
 
+# closed enums the self-tuning plane (hpnn_tpu/tune/engine.py) is
+# allowed to emit — kept in lockstep with RULE_OF / VERDICTS there
+TUNE_ACTIONS = ("scale_up", "precision_down", "grow_buckets",
+                "quota_squeeze")
+TUNE_VERDICTS = ("apply", "veto", "dry_run", "no_actuator",
+                 "watch_active", "cooldown", "burn_ok", "no_dominant",
+                 "thin_window", "no_sensor")
+TUNE_PHASES = ("queue", "dispatch", "spill", "shed_retry")
+BLAME_PCT_GAUGES = ("blame.queue_pct", "blame.dispatch_pct",
+                    "blame.spill_pct", "blame.shed_pct",
+                    "blame.other_pct", "blame.gap_pct")
+
+
+def lint_tune(path: str) -> list[str]:
+    """Schema-lint the self-tuning audit trail of one metrics sink
+    (a run with ``HPNN_TUNE`` + ``HPNN_BLAME`` armed —
+    docs/selftuning.md).
+
+    The remediation plane moves production serving knobs on its own;
+    these records are the only proof every move was attributable and
+    reversible, so their shapes are frozen:
+
+    * ``tune.apply`` — non-empty ``id``; ``action`` in the closed
+      enum; ``phase`` a blame class; ``pct`` finite in [0, 100];
+      ``prior`` and ``applied`` both present (no prior snapshot = no
+      rollback target); ``cooldown_s``/``watch_s`` finite >= 0.
+    * ``tune.rollback`` — its ``id`` must pair a *previously seen*
+      apply (an orphan rollback restored nothing anyone applied);
+      ``action`` in the enum; non-empty ``reason``; ``restored``
+      present.
+    * ``tune.decision`` — ``verdict`` on the closed enum; ``roots``
+      a non-negative int; ``burn`` None or finite.
+    * ``blame.*_pct`` gauges — finite shares in [0, 100];
+      ``blame.window_roots`` finite >= 0.
+
+    A sink with no ``tune.*`` records fails — this lint only makes
+    sense on a tune-armed run.  Returns failure strings
+    (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_tune = 0
+    apply_ids: set[str] = set()
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev == "tune.apply":
+            n_tune += 1
+            aid = rec.get("id")
+            if not isinstance(aid, str) or not aid:
+                failures.append(
+                    f"{at}: tune.apply id {aid!r} is not a non-empty "
+                    "string — an unnamed apply cannot be paired with "
+                    "its rollback")
+            else:
+                apply_ids.add(aid)
+            if rec.get("action") not in TUNE_ACTIONS:
+                failures.append(
+                    f"{at}: tune.apply action {rec.get('action')!r} "
+                    f"not in {'/'.join(TUNE_ACTIONS)}")
+            if rec.get("phase") not in TUNE_PHASES:
+                failures.append(
+                    f"{at}: tune.apply phase {rec.get('phase')!r} is "
+                    "not an actionable blame class "
+                    f"({'/'.join(TUNE_PHASES)})")
+            pct = rec.get("pct")
+            if (not _num(pct) or not math.isfinite(pct)
+                    or not 0.0 <= pct <= 100.0):
+                failures.append(
+                    f"{at}: tune.apply pct {pct!r} is not a finite "
+                    "share in [0, 100]")
+            for key in ("prior", "applied"):
+                if key not in rec:
+                    failures.append(
+                        f"{at}: tune.apply has no {key} field — "
+                        "without the prior snapshot the move is not "
+                        "reversible, without applied it is not "
+                        "auditable")
+            for key in ("cooldown_s", "watch_s"):
+                v = rec.get(key)
+                if not _num(v) or not math.isfinite(v) or v < 0:
+                    failures.append(
+                        f"{at}: tune.apply {key} {v!r} is not a "
+                        "finite non-negative number")
+        elif ev == "tune.rollback":
+            n_tune += 1
+            rid = rec.get("id")
+            if not isinstance(rid, str) or not rid:
+                failures.append(
+                    f"{at}: tune.rollback id {rid!r} is not a "
+                    "non-empty string")
+            elif rid not in apply_ids:
+                failures.append(
+                    f"{at}: tune.rollback id {rid!r} pairs no "
+                    "preceding tune.apply — an orphan rollback "
+                    "restored nothing anyone applied")
+            if rec.get("action") not in TUNE_ACTIONS:
+                failures.append(
+                    f"{at}: tune.rollback action "
+                    f"{rec.get('action')!r} not in "
+                    f"{'/'.join(TUNE_ACTIONS)}")
+            reason = rec.get("reason")
+            if not isinstance(reason, str) or not reason:
+                failures.append(
+                    f"{at}: tune.rollback reason {reason!r} is not a "
+                    "non-empty string — an unexplained undo is not "
+                    "an audit trail")
+            if "restored" not in rec:
+                failures.append(
+                    f"{at}: tune.rollback has no restored field — "
+                    "cannot verify the prior config came back")
+        elif ev == "tune.decision":
+            n_tune += 1
+            if rec.get("verdict") not in TUNE_VERDICTS:
+                failures.append(
+                    f"{at}: tune.decision verdict "
+                    f"{rec.get('verdict')!r} not in the closed enum "
+                    f"({'/'.join(TUNE_VERDICTS)})")
+            roots = rec.get("roots")
+            if (not isinstance(roots, int) or isinstance(roots, bool)
+                    or roots < 0):
+                failures.append(
+                    f"{at}: tune.decision roots {roots!r} is not a "
+                    "non-negative int")
+            burn = rec.get("burn")
+            if burn is not None and (not _num(burn)
+                                     or not math.isfinite(burn)):
+                failures.append(
+                    f"{at}: tune.decision burn {burn!r} is neither "
+                    "None nor a finite number")
+        elif ev == "tune.error":
+            n_tune += 1
+            err = rec.get("error")
+            if not isinstance(err, str) or not err:
+                failures.append(
+                    f"{at}: tune.error error {err!r} is not a "
+                    "non-empty string")
+        elif ev in BLAME_PCT_GAUGES and rec.get("kind") == "gauge":
+            v = rec.get("value")
+            if (not _num(v) or not math.isfinite(v)
+                    or not 0.0 <= v <= 100.0):
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a finite share "
+                    "in [0, 100]")
+        elif ev == "blame.window_roots" and rec.get("kind") == "gauge":
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: blame.window_roots value {v!r} is not a "
+                    "finite non-negative number")
+    if not n_tune:
+        failures.append(
+            f"sink {path!r} has no tune.* records — was HPNN_TUNE "
+            "armed during this run?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -2257,6 +2436,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_meter(argv[i + 1])
+    if "--tune" in argv:
+        i = argv.index("--tune")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --tune needs a "
+                             "path\n")
+            return 2
+        failures += lint_tune(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -43,7 +43,12 @@ forensics plane (``HPNN_SAMPLE`` at rate 1 plus ``HPNN_CAPSULE_DIR``
 manifest, while stdout stays frozen), the drift-detection plane
 (``HPNN_DRIFT`` — its taps live in online ingest, serve dispatch, and
 the online trainer's holdout evals, none on the train path, so armed
-sketches must stay inert here), and a
+sketches must stay inert here), the online blame engine + the
+self-tuning remediation plane (``HPNN_BLAME`` / ``HPNN_TUNE``,
+docs/selftuning.md — blame taps the forensics sampler's request
+roots and the tuner rides serve ``Session`` construction, neither of
+which a plain train round touches, so armed they must stay inert
+here), and a
 live export server whose
 ``/metrics`` endpoint is scraped inside the capture window — so
 "byte-frozen" is proven against the maximal configuration, not the
@@ -253,6 +258,15 @@ def check(tmpdir: str) -> list[str]:
     # queue edge / tenant admission, none of which a plain train round
     # touches — armed, it must stay inert on stdout and the sink
     os.environ["HPNN_METER"] = "1"
+    # online blame + self-tuning (docs/selftuning.md) ride the same
+    # proof: blame only sees sampler-emitted request roots and the
+    # tuner only starts inside a serve Session, so a plain train
+    # round must not move a byte with both armed
+    from hpnn_tpu import tune as tune_mod
+    from hpnn_tpu.obs import blame as blame_mod
+
+    os.environ["HPNN_BLAME"] = "1"
+    os.environ["HPNN_TUNE"] = "1"
     for knob, val in _ONLINE_KNOBS:
         os.environ[knob] = val
     chaos_mod._reset_for_tests()
@@ -261,6 +275,8 @@ def check(tmpdir: str) -> list[str]:
     triggers_mod._reset_for_tests()
     drift_mod._reset_for_tests()
     meter_mod._reset_for_tests()
+    blame_mod._reset_for_tests()
+    tune_mod._reset_for_tests()
     try:
         instrumented = _run_round(os.path.join(tmpdir, "b"), sink,
                                   probe=probe)
@@ -273,7 +289,7 @@ def check(tmpdir: str) -> list[str]:
                      "HPNN_SAMPLE", "HPNN_CAPSULE_DIR",
                      "HPNN_CAPSULE_PROFILE_MS",
                      "HPNN_CAPSULE_COOLDOWN_S", "HPNN_DRIFT",
-                     "HPNN_METER") \
+                     "HPNN_METER", "HPNN_BLAME", "HPNN_TUNE") \
                 + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
         chaos_mod._reset_for_tests()
@@ -282,6 +298,8 @@ def check(tmpdir: str) -> list[str]:
         triggers_mod._reset_for_tests()
         drift_mod._reset_for_tests()
         meter_mod._reset_for_tests()
+        blame_mod._reset_for_tests()
+        tune_mod._reset_for_tests()
 
     if plain != instrumented:
         failures.append(
@@ -292,6 +310,7 @@ def check(tmpdir: str) -> list[str]:
             "(firing rule) + HPNN_SAMPLE + HPNN_CAPSULE_DIR "
             "(alert-triggered capture) + HPNN_DRIFT (armed "
             "sketches) + HPNN_METER (armed metering) + "
+            "HPNN_BLAME + HPNN_TUNE (armed blame/tuning) + "
             "HPNN_ONLINE_* (incl. "
             "HPNN_ONLINE_SCAN_K) + "
             "HPNN_SERVE_DTYPE=bf16 + export server all enabled "

@@ -28,7 +28,7 @@ CONFIG_REL = os.path.join("hpnn_tpu", "config.py")
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
              "docs/fleet.md", "docs/online.md", "docs/resilience.md",
              "docs/performance.md", "docs/analysis.md",
-             "docs/api.md", "docs/tenancy.md")
+             "docs/api.md", "docs/tenancy.md", "docs/selftuning.md")
 REQUIRED_KEYS = ("default", "doc", "desc")
 
 

@@ -36,7 +36,7 @@ ROW_RE = re.compile(
 DOC_PAGES = ("docs/observability.md", "docs/serving.md",
              "docs/fleet.md", "docs/online.md", "docs/resilience.md",
              "docs/performance.md", "docs/analysis.md",
-             "docs/tenancy.md")
+             "docs/tenancy.md", "docs/selftuning.md")
 
 
 def _covered(name: str, documented: set[str]) -> bool:

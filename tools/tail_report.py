@@ -62,31 +62,44 @@ import sys
 TOOLS = os.path.dirname(os.path.abspath(__file__))
 if TOOLS not in sys.path:
     sys.path.insert(0, TOOLS)
+REPO = os.path.dirname(TOOLS)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 import obs_report  # noqa: E402  (sibling tool, loaders reused)
 
-ROOT_NAMES = ("serve.request", "cluster.request")
-PHASES = ("queue", "dispatch", "spill", "shed_retry", "other", "gap")
+# the classifier core is SHARED with the in-process streaming engine
+# (hpnn_tpu/obs/blame.py, HPNN_BLAME): one phase_of / one
+# exclusive-time split / one analyze, so the online rolling gauges and
+# this offline report can never drift apart.  The package import is
+# preferred (one module instance when hpnn_tpu is importable); the
+# file-path fallback keeps this report rendering on a login node
+# where hpnn_tpu's dependencies are absent — blame.py's core is
+# import-clean stdlib, its registry hook deferred to the armed
+# publish path.  tests/test_blame.py pins the analyze output against
+# a golden sink to hold the refactor behavior-identical.
+try:
+    from hpnn_tpu.obs import blame as _core
+except ImportError:  # bare login node: load the core standalone
+    import importlib.util
+
+    _spec = importlib.util.spec_from_file_location(
+        "hpnn_tpu_obs_blame",
+        os.path.join(REPO, "hpnn_tpu", "obs", "blame.py"))
+    _core = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(_core)
+
+ROOT_NAMES = _core.ROOT_NAMES
+PHASES = _core.PHASES
 
 # rejected-attempt markers (serve/batcher.py raises, spans record the
 # exception class in the ``failed`` field)
-_SHED_FAILS = ("Shed", "QueueFull")
+_SHED_FAILS = _core.SHED_FAILS
 
-
-def _phase_of(span: dict) -> str:
-    """Classify one descendant span into a blame phase by name (the
-    shed/retry check wins: a failed dispatch attempt is retry waste,
-    not useful device time)."""
-    if span["fields"].get("failed") in _SHED_FAILS:
-        return "shed_retry"
-    name = span["name"] or ""
-    if name.endswith(".queue") or ".queue" in name:
-        return "queue"
-    if "dispatch" in name:
-        return "dispatch"
-    if "spill" in name:
-        return "spill"
-    return "other"
+_phase_of = _core.phase_of
+request_roots = _core.request_roots
+blame = _core.split
+analyze = _core.analyze
 
 
 def load_spans(paths: list[str]) -> list[dict]:
@@ -97,82 +110,6 @@ def load_spans(paths: list[str]) -> list[dict]:
     else:
         events = obs_report.merge_events(paths)
     return obs_report.collect_spans(events)
-
-
-def request_roots(spans: list[dict],
-                  root_names=ROOT_NAMES) -> list[dict]:
-    """The outermost request spans: named like a request root AND not
-    nested under another collected span (a ``serve.request`` under a
-    ``cluster.request`` blames into its parent, not the table)."""
-    by_ref = {s["ref"]: s for s in spans if s["ref"] is not None}
-    return [s for s in spans
-            if s["name"] in root_names
-            and by_ref.get(s["parent_ref"]) is None]
-
-
-def _descendants(root: dict, children_of: dict) -> list[dict]:
-    out: list[dict] = []
-    stack = [root]
-    while stack:
-        for child in children_of.get(stack.pop()["ref"], ()):
-            out.append(child)
-            stack.append(child)
-    return out
-
-
-def blame(root: dict, children_of: dict) -> dict:
-    """The per-phase wall-time split of one request root: exclusive
-    descendant time charged per phase, the uncovered remainder as
-    ``gap``.  Values in seconds; they sum to ``root['dt']`` up to
-    clock skew on remote children (each clamped at 0)."""
-    phases = {p: 0.0 for p in PHASES}
-    for d in _descendants(root, children_of):
-        kids = children_of.get(d["ref"], ())
-        exclusive = max(0.0, d["dt"] - sum(c["dt"] for c in kids))
-        phases[_phase_of(d)] += exclusive
-    covered = sum(phases.values())
-    phases["gap"] = max(0.0, root["dt"] - covered)
-    return phases
-
-
-def analyze(spans: list[dict], *, top: int = 10,
-            root_names=ROOT_NAMES) -> dict:
-    """The machine-form report: slowest-N roots with per-phase blame
-    plus the aggregate split over every root."""
-    children_of: dict = {}
-    by_ref = {s["ref"]: s for s in spans if s["ref"] is not None}
-    for s in spans:
-        parent = by_ref.get(s["parent_ref"])
-        if parent is not None and parent is not s:
-            children_of.setdefault(parent["ref"], []).append(s)
-    roots = request_roots(spans, root_names)
-    agg = {p: 0.0 for p in PHASES}
-    rows = []
-    for root in roots:
-        phases = blame(root, children_of)
-        for p, v in phases.items():
-            agg[p] += v
-        rows.append({
-            "name": root["name"],
-            "ref": root["ref"],
-            "dt": root["dt"],
-            "req_id": root["fields"].get("req_id"),
-            "trace": root["fields"].get("trace"),
-            "sampled": bool(root["fields"].get("sampled")),
-            "promoted": bool(root["fields"].get("promoted")),
-            "failed": root["fields"].get("failed"),
-            "phases": {p: round(v, 6) for p, v in phases.items()},
-        })
-    rows.sort(key=lambda r: -r["dt"])
-    total = sum(agg.values())
-    return {
-        "spans": len(spans),
-        "requests": len(roots),
-        "slowest": rows[:top],
-        "blame_total_s": {p: round(v, 6) for p, v in agg.items()},
-        "blame_pct": {p: round(100.0 * v / total, 2) if total else 0.0
-                      for p, v in agg.items()},
-    }
 
 
 def compare(rep: dict, base: dict) -> dict:
