@@ -1019,6 +1019,133 @@ def bench_meter_overhead(iters: int = 300, repeats: int = 7,
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_conn_overhead(iters: int = 150, repeats: int = 5):
+    """Paired measurement of the connection plane's MARGINAL cost on
+    the HTTP serve path (docs/serving.md "Connection plane"): the
+    same keep-alive ``POST /v1/infer`` loop against two ``make_server``
+    front ends over ONE warm Session — one with every ``HPNN_CONN_*``
+    guard armed (raw-I/O byte accounting, read deadlines, per-IP
+    bookkeeping, the byte-rate watchdog), one unarmed (the strict
+    no-op path).  The env memo is re-pointed before each leg so the
+    handler-side knob reads match the server being driven.  Quantifies
+    the claim that always-on socket telemetry is affordable — the bar
+    is ≤ 5%; tools/bench_gate.py gates ``conn_overhead_pct``."""
+    import http.client
+    import socket
+    import threading
+
+    from hpnn_tpu import serve
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve import conn as conn_mod
+
+    conn_keys = ("HPNN_CONN_HDR_MS", "HPNN_CONN_BODY_MS",
+                 "HPNN_CONN_PER_IP", "HPNN_CONN_MIN_BPS",
+                 "HPNN_CONN_TABLE")
+    saved = {k: os.environ.pop(k, None) for k in conn_keys}
+
+    def arm(on: bool) -> None:
+        if on:
+            os.environ["HPNN_CONN_HDR_MS"] = "10000"
+            os.environ["HPNN_CONN_BODY_MS"] = "10000"
+            os.environ["HPNN_CONN_PER_IP"] = "64"
+            os.environ["HPNN_CONN_MIN_BPS"] = "1"
+            os.environ["HPNN_CONN_TABLE"] = "64"
+        else:
+            for k in conn_keys:
+                os.environ.pop(k, None)
+        conn_mod._reset_for_tests()
+
+    n_in, n_hid, n_out = FLEET_SHAPE
+    kern = kernel_mod.generate(4244, n_in, [n_hid], n_out)[0]
+    body = json.dumps(
+        {"kernel": "bench",
+         "inputs": [[0.1] * n_in], "timeout_s": 10.0}).encode()
+    hdrs = {"Content-Type": "application/json"}
+
+    def drive(port: int, n: int) -> tuple[float, int]:
+        client = http.client.HTTPConnection("127.0.0.1", port,
+                                            timeout=10.0)
+        client.connect()
+        client.sock.setsockopt(socket.IPPROTO_TCP,
+                               socket.TCP_NODELAY, 1)
+        bad = 0
+        try:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                client.request("POST", "/v1/infer", body=body,
+                               headers=hdrs)
+                resp = client.getresponse()
+                resp.read()
+                bad += resp.status != 200
+            return time.perf_counter() - t0, bad
+        finally:
+            client.close()
+
+    sess = None
+    servers: list = []
+    try:
+        sess = serve.Session(max_batch=8, n_buckets=2,
+                             max_wait_ms=0.5)
+        sess.register_kernel("bench", kern)
+        arm(False)
+        server_off = serve.make_server(sess, port=0)
+        servers.append(server_off)
+        arm(True)
+        server_on = serve.make_server(sess, port=0)
+        servers.append(server_on)
+        for server in servers:
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+        port_off = server_off.server_address[1]
+        port_on = server_on.server_address[1]
+
+        # warm both legs (compile, route, thread pools)
+        arm(False)
+        drive(port_off, 10)
+        arm(True)
+        drive(port_on, 10)
+
+        on_s, off_s = [], []
+        errors = 0
+        for _ in range(repeats):
+            arm(False)
+            dt, bad = drive(port_off, iters)
+            off_s.append(dt)
+            errors += bad
+            arm(True)
+            dt, bad = drive(port_on, iters)
+            on_s.append(dt)
+            errors += bad
+        # the proof the "on" leg was actually guarded: its census
+        # must have admitted and request-counted the driver
+        census = conn_mod.connz_doc(server_on)
+        deltas = [round(100.0 * (a - b) / b, 2)
+                  for a, b in zip(on_s, off_s)]
+        return {
+            "iters": iters,
+            "http_s_conn_off": _stats([round(v, 4) for v in off_s]),
+            "http_s_conn_on": _stats([round(v, 4) for v in on_s]),
+            "paired_overhead_pct": {
+                "per_round": deltas,
+                "median": round(statistics.median(deltas), 2),
+            },
+            "errors": errors,
+            "guarded_conns_opened": census.get("opened", 0),
+        }
+    finally:
+        for server in servers:
+            server.shutdown()
+            server.server_close()
+        if sess is not None:
+            sess.close()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        conn_mod._reset_for_tests()
+
+
 FLEET_MEMBERS = 64
 FLEET_SHAPE = (32, 16, 4)   # HPNN-sized: the paper's natural workload
 FLEET_TICKS = 30
@@ -1436,6 +1563,16 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["meter_overhead_error"] = repr(exc)
 
+    # connection-plane overhead: the same paired shape over the HTTP
+    # serve path, every HPNN_CONN_* guard armed in one leg
+    # (docs/serving.md "Connection plane") — rides the same skip
+    # knob, best-effort
+    if not os.environ.get("HPNN_BENCH_NO_OBS_OVERHEAD"):
+        try:
+            out["conn_overhead"] = bench_conn_overhead()
+        except Exception as exc:
+            out["conn_overhead_error"] = repr(exc)
+
     # HPNN_METRICS: the bench subprocesses/rounds inherit the knob, so
     # the run's structured events land in the sink — record where, and
     # fold obs_report's machine summary in (best-effort: a torn sink
@@ -1723,6 +1860,22 @@ def main(argv=None) -> None:
         except Exception as exc:
             out["tune_drill_error"] = repr(exc)
 
+    # Torn-network drill (tools/chaos_drill.py run_bench_torn_drill):
+    # slowloris/torn-body/fuzz clients attack a conn-guarded server
+    # while clean traffic flows — prove the guards kill the attackers,
+    # account every hostile close, fire the alert and capsule, and
+    # keep clean goodput intact (docs/resilience.md).  Rides the same
+    # HPNN_BENCH_NO_DRILL knob (in-process, a few seconds).
+    if not os.environ.get("HPNN_BENCH_NO_DRILL"):
+        try:
+            sys.path.insert(0, os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools"))
+            import chaos_drill
+
+            out["torn_drill"] = chaos_drill.run_bench_torn_drill()
+        except Exception as exc:
+            out["torn_drill_error"] = repr(exc)
+
     # The driver records only a ~4 kB tail of stdout (BENCH_r04.json
     # lost its headline to exactly this): the full detail goes to a
     # file, stdout ends with ONE compact line that always fits.
@@ -1873,6 +2026,11 @@ def main(argv=None) -> None:
         td = out["tune_drill"]
         compact["drill_tune_applies"] = td["applies"]
         compact["drill_tune_rollback_bitwise"] = td["rollback_bitwise"]
+    if ("torn_drill" in out
+            and out["torn_drill"].get("dip_pct") is not None):
+        tn = out["torn_drill"]
+        compact["drill_torn_dip_pct"] = tn["dip_pct"]
+        compact["drill_torn_clean_lost"] = tn["clean_lost"]
     if ("autoscale" in out
             and out["autoscale"].get("goodput_x") is not None):
         asc = out["autoscale"]
@@ -1903,6 +2061,10 @@ def main(argv=None) -> None:
     if "meter_overhead" in out:
         compact["meter_overhead_pct"] = (
             out["meter_overhead"]["paired_overhead_pct"]["median"]
+        )
+    if "conn_overhead" in out:
+        compact["conn_overhead_pct"] = (
+            out["conn_overhead"]["paired_overhead_pct"]["median"]
         )
     compact["detail_file"] = detail_path
     if "obs_metrics_file" in out:

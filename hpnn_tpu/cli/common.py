@@ -24,6 +24,22 @@ def install_sigpipe_handler() -> None:
         pass
 
 
+def shield_sigpipe_for_server() -> None:
+    """Put SIGPIPE back to ignored before entering a serve loop.
+
+    The SIG_DFL disposition above is right for the short-lived token
+    CLIs (pipe closes, process dies quietly) but fatal for a server:
+    with it armed, any write to a peer-reset socket — a hostile
+    client, or the connection plane's own guard yanking an offender —
+    kills the whole process instead of raising the BrokenPipeError
+    the handler-side accounting converts into a counted close.  Call
+    after argument/help handling, before ``serve_forever``."""
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+    except (ValueError, AttributeError):
+        pass
+
+
 def dump_help(prog: str) -> None:
     w = sys.stdout.write
     w("***********************************\n")

@@ -86,6 +86,7 @@ def main(argv: list[str] | None = None) -> int:
             scrape, interval_s=float(opts.get("interval", 5.0)))
         sys.stderr.write(
             f"obs_collector: scraping {len(scrape)} endpoint(s)\n")
+    common.shield_sigpipe_for_server()
     try:
         # join in slices: a bare join() can mask KeyboardInterrupt
         while server._thread.is_alive():

@@ -162,6 +162,7 @@ def main(argv: list[str] | None = None) -> int:
 
     threading.Thread(target=_warm, daemon=True,
                      name="hpnn-online-warm").start()
+    common.shield_sigpipe_for_server()
     try:
         server.serve_forever()
     except KeyboardInterrupt:

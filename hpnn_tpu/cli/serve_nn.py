@@ -106,6 +106,7 @@ def main(argv: list[str] | None = None) -> int:
         f"serve_nn: kernel {session.kernels()[0]!r} resident, "
         f"buckets {list(session.engine.buckets)}, "
         f"listening on {host}:{port}\n")
+    common.shield_sigpipe_for_server()
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -451,6 +451,22 @@ KNOBS = {
     "HPNN_COMPILE_CACHE_MAX_MB": {
         "default": None, "doc": "docs/tenancy.md",
         "desc": "compile-cache GC size cap in MiB (0/unset = no GC)"},
+    # --- connection plane (docs/serving.md) ---
+    "HPNN_CONN_HDR_MS": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "request-header read deadline in ms (arms conn plane)"},
+    "HPNN_CONN_BODY_MS": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "request-body read deadline in ms (arms conn plane)"},
+    "HPNN_CONN_PER_IP": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "max concurrent connections admitted per client IP"},
+    "HPNN_CONN_MIN_BPS": {
+        "default": None, "doc": "docs/serving.md",
+        "desc": "slow-client floor: min bytes/s while reading a request"},
+    "HPNN_CONN_TABLE": {
+        "default": 1024, "doc": "docs/serving.md",
+        "desc": "bounded live-connection table size (census rows)"},
     # --- multi-tenant hosting (docs/tenancy.md) ---
     "HPNN_TENANT_SHARDS": {
         "default": 16, "doc": "docs/tenancy.md",

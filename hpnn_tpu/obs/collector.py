@@ -34,7 +34,12 @@ both halves of the missing hop:
   workers cannot push.  With ``HPNN_CAPSULE_DIR`` armed it also
   answers ``POST /v1/capture`` — a manual forensic capsule of the
   collector process (obs/triggers.py) — and ``/healthz`` carries the
-  capsule census.
+  capsule census.  The socket layer under the endpoint is the same
+  connection plane the serve front end rides (hpnn_tpu/serve/conn.py,
+  lazily imported so ``import hpnn_tpu.obs`` stays light): with
+  ``HPNN_CONN_*`` knobs armed the collector gets per-connection
+  open/close accounting, read deadlines, the per-IP cap and
+  slow-client guard, and a ``GET /connz`` census of its own.
 
 Batch wire format (``POST /v1/telemetry``, JSON)::
 
@@ -506,6 +511,14 @@ class _CollectorHandler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(doc).encode("utf-8"),
                    "application/json")
 
+    def _read_body(self, n: int) -> bytes:
+        # the connection plane's deadline + torn-upload accounting
+        # (serve/conn.py, lazy so `import hpnn_tpu.obs` stays light);
+        # a plain read when the plane is unarmed
+        from hpnn_tpu.serve import conn as conn_mod
+
+        return conn_mod.read_body(self, n)
+
     def do_POST(self):
         if self.path == "/v1/capture":
             # manual forensic capsule of the collector process itself
@@ -513,7 +526,7 @@ class _CollectorHandler(BaseHTTPRequestHandler):
             # and the recv census land in gauges.json/health.json
             try:
                 n = int(self.headers.get("Content-Length") or 0)
-                body = json.loads(self.rfile.read(n) or b"{}")
+                body = json.loads(self._read_body(n) or b"{}")
             except (ValueError, json.JSONDecodeError):
                 body = None
             from hpnn_tpu.obs import triggers
@@ -527,7 +540,7 @@ class _CollectorHandler(BaseHTTPRequestHandler):
             return
         try:
             n = int(self.headers.get("Content-Length") or 0)
-            doc = json.loads(self.rfile.read(n).decode("utf-8"))
+            doc = json.loads(self._read_body(n).decode("utf-8"))
             pid = int(doc["pid"])
             rank = int(doc.get("rank") or 0)
             lines = doc["lines"]
@@ -555,6 +568,12 @@ class _CollectorHandler(BaseHTTPRequestHandler):
                 self._send_json(200, doc)
         elif self.path == "/healthz":
             self._send_json(200, self.collector.healthz())
+        elif self.path == "/connz":
+            # connection-plane census of the collector's own endpoint
+            # (serve/conn.py); {"mode": "off"} when unarmed
+            from hpnn_tpu.serve import conn as conn_mod
+
+            self._send_json(200, conn_mod.connz_doc(self.server))
         else:
             self._send_json(404, {"error": "not found"})
 
@@ -565,12 +584,18 @@ def start_collector(host: str = "127.0.0.1", port: int = 0,
     """Start the collector endpoint on a daemon thread; returns the
     server (``server.server_address`` carries the bound port,
     ``server.collector`` the aggregation state)."""
+    from hpnn_tpu.serve import conn as conn_mod
+
     coll = Collector(path=path, queue_max=queue_max)
-    handler = type("_BoundCollectorHandler", (_CollectorHandler,),
+    handler = type("_BoundCollectorHandler",
+                   (conn_mod.ConnHandlerMixin, _CollectorHandler),
                    {"collector": coll})
     server = ThreadingHTTPServer((host, port), handler)
     server.daemon_threads = True
     server.collector = coll
+    # connection-plane telemetry + guards on the collector's own
+    # socket layer (a no-op unless an HPNN_CONN_* knob is armed)
+    conn_mod.wrap_server(server, plane="collector")
     thread = threading.Thread(target=server.serve_forever,
                               name="hpnn-obs-collector-http", daemon=True)
     server._thread = thread

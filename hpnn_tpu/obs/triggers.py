@@ -238,6 +238,15 @@ def _assemble(path: str, reason: str, detail: dict | None,
             # absent when HPNN_BLAME is unarmed)
             _write("blame.json",
                    json.dumps(phase_split, indent=1, default=str))
+        from hpnn_tpu.serve import conn
+
+        census = conn.sketch_doc()
+        if census is not None:
+            # who was on the wire when it fired: the connection-plane
+            # census — live table, close-reason + guard-kill totals
+            # (serve/conn.py; absent when HPNN_CONN_* is unarmed)
+            _write("conn.json",
+                   json.dumps(census, indent=1, default=str))
 
         profile = _profile_window(os.path.join(path, "profile"),
                                   cfg.get("profile_ms", 0.0))

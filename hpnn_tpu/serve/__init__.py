@@ -16,6 +16,10 @@ zero steady-state compiles.  Layers, bottom up:
   deadlines, explicit backpressure, and SLO-driven load shedding;
 * :mod:`~hpnn_tpu.serve.server` — :class:`Session` (the in-process
   embedding API) and the stdlib HTTP front end;
+* :mod:`~hpnn_tpu.serve.conn` — connection-plane telemetry and guards
+  under the HTTP front ends (``HPNN_CONN_*``): per-connection
+  open/close accounting, read deadlines, per-IP cap, slow-client
+  byte-rate guard, ``/connz`` census (docs/serving.md);
 * :mod:`~hpnn_tpu.serve.replica` / :mod:`~hpnn_tpu.serve.router` —
   data-parallel scale-out: N device-pinned Session replicas behind a
   least-outstanding-requests router with shed/unready awareness, a
@@ -30,7 +34,7 @@ the first compile, same discipline as ``hpnn_tpu.obs``.  Architecture
 and semantics: docs/serving.md.
 """
 
-from hpnn_tpu.serve import compile_cache
+from hpnn_tpu.serve import compile_cache, conn
 from hpnn_tpu.serve.batcher import Batcher, DeadlineExceeded, QueueFull, Shed
 from hpnn_tpu.serve.engine import Engine, bucket_for, bucket_menu
 from hpnn_tpu.serve.registry import Entry, Registry, RegistryError
@@ -53,6 +57,7 @@ __all__ = [
     "Router",
     "Session",
     "compile_cache",
+    "conn",
     "install_drain",
     "make_server",
 ]

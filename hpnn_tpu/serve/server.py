@@ -52,6 +52,19 @@ the process boundary, and echo ``X-Trace-Id`` back.
   rolled-back/vetoed counts, the armed watch, recent decision ledger
   (hpnn_tpu/tune/; docs/selftuning.md); 404 when ``HPNN_TUNE`` is
   unarmed.
+* ``GET /connz`` → the connection-plane census — live connection
+  table, close-reason and guard-kill totals, per-IP census
+  (hpnn_tpu/serve/conn.py; docs/serving.md); ``{"mode": "off"}``
+  when no ``HPNN_CONN_*`` knob is armed.
+
+The socket layer beneath the handlers is instrumented and guarded by
+``serve/conn.py`` (``make_server`` wires it, so Router replicas and
+ClusterRouter workers inherit it): accepted connections carry a
+default socket timeout, handler-thread ``socket.timeout`` /
+``ConnectionResetError`` become counted ``conn.close`` events instead
+of stderr stack traces, and with the knobs armed the plane adds
+header/body read deadlines, a per-IP cap, and a slow-client
+byte-rate guard.
 
 SIGTERM graceful drain: :func:`install_drain` chains a handler that
 stops admission (readiness flips, new arrivals get 503 +
@@ -80,7 +93,7 @@ import numpy as np
 
 from hpnn_tpu import obs, tune
 from hpnn_tpu.models import kernel as kernel_mod
-from hpnn_tpu.serve import compile_cache
+from hpnn_tpu.serve import compile_cache, conn
 from hpnn_tpu.serve.batcher import (Batcher, DeadlineExceeded, QueueFull,
                                     Shed)
 from hpnn_tpu.serve.engine import (DEFAULT_MAX_BATCH, DEFAULT_N_BUCKETS,
@@ -478,7 +491,7 @@ def _retry_after(exc: QueueFull) -> str:
     return "1"
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(conn.ConnHandlerMixin, BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "hpnn-serve/0.1"
     # one TCP segment per response: with the default unbuffered wfile,
@@ -551,6 +564,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(404, {"error": "tune not armed"})
             else:
                 self._reply(200, doc)
+        elif self.path == "/connz":
+            # connection-plane census (serve/conn.py): live table,
+            # close-reason + guard-kill totals; {"mode": "off"} when
+            # no HPNN_CONN_* knob is armed
+            self._reply(200, conn.connz_doc(self.server))
         elif self.path == "/metrics":
             body, ctype = obs.export.metrics_response(
                 self.headers.get("Accept"))
@@ -565,7 +583,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _read_json(self) -> dict | None:
         try:
             n = int(self.headers.get("Content-Length", "0"))
-            obj = json.loads(self.rfile.read(n) or b"{}")
+            # conn.read_body applies the HPNN_CONN_BODY_MS deadline and
+            # accounts torn uploads — the untimed blocking read was the
+            # connection plane's original blind spot
+            obj = json.loads(conn.read_body(self, n) or b"{}")
         except (ValueError, json.JSONDecodeError):
             return None
         return obj if isinstance(obj, dict) else None
@@ -776,6 +797,10 @@ def make_server(session: Session, host: str = "127.0.0.1",
     server.daemon_threads = True
     server.session = session  # type: ignore[attr-defined]
     server.rate_cap = _rate_cap_from_env()  # type: ignore[attr-defined]
+    # connection-plane telemetry + guards (serve/conn.py): a no-op
+    # unless an HPNN_CONN_* knob is armed; wiring it here is what lets
+    # Router replicas and ClusterRouter workers inherit it for free
+    conn.wrap_server(server, plane="serve")
     obs.event("serve.listen", host=host,
               port=server.server_address[1])
     return server
@@ -810,6 +835,9 @@ def install_drain(server: ThreadingHTTPServer, session: Session):
         done.set()
         session.mark_unready("draining")
         obs.event("serve.drain", signal=int(signum))
+        # idle keep-alive connections are closed now with a counted
+        # reason=drain; in-flight requests keep their sockets
+        conn.drain_server(server)
         try:
             session.close()
         except Exception as exc:  # drain must finish no matter what

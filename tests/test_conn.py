@@ -1,0 +1,292 @@
+"""Connection-plane guards (hpnn_tpu/serve/conn.py, docs/serving.md
+"Connection plane").
+
+Acceptance bar (ISSUE): the guard edges behave — a deadline hit
+mid-header is distinguishable from one hit mid-body in the close
+record's ``phase``; the per-IP cap refuses the N+1th connection as a
+fully counted ``guard``/``admit`` close and re-admits once one of the
+N closes; ``drain_server`` closes idle keep-alive connections with
+reason ``drain`` while leaving nothing unaccounted; and the bounded
+census table degrades gracefully past ``HPNN_CONN_TABLE`` (rows
+capped, overflow counted as untracked, aggregates still exact).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from hpnn_tpu import obs, serve
+from hpnn_tpu.serve import conn
+
+
+KNOBS = (conn.ENV_HDR_MS, conn.ENV_BODY_MS, conn.ENV_PER_IP,
+         conn.ENV_MIN_BPS, conn.ENV_TABLE)
+
+
+def _wait(pred, timeout_s=8.0, interval_s=0.05):
+    """Poll ``pred`` until truthy; returns its last value."""
+    deadline = time.monotonic() + timeout_s
+    val = pred()
+    while not val and time.monotonic() < deadline:
+        time.sleep(interval_s)
+        val = pred()
+    return val
+
+
+def _records(sink, ev):
+    if not os.path.exists(sink):
+        return []
+    out = []
+    with open(sink) as fp:
+        for ln in fp:
+            if not ln.strip():
+                continue
+            try:
+                r = json.loads(ln)
+            except ValueError:
+                continue  # a torn tail line mid-write
+            if r.get("ev") == ev:
+                out.append(r)
+    return out
+
+
+def _recv_eof(sock, timeout_s=8.0):
+    """True when the server closed this connection (EOF / reset)."""
+    sock.settimeout(timeout_s)
+    try:
+        while True:
+            if not sock.recv(4096):
+                return True
+    except (ConnectionResetError, BrokenPipeError):
+        return True
+    except socket.timeout:
+        return False
+
+
+def _get(sock, path="/healthz"):
+    """One keep-alive GET over a raw socket; returns the status line."""
+    sock.sendall(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    sock.settimeout(8.0)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise AssertionError(f"EOF before response headers: {buf!r}")
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    n = 0
+    for ln in head.split(b"\r\n"):
+        if ln.lower().startswith(b"content-length:"):
+            n = int(ln.split(b":", 1)[1])
+    while len(rest) < n:
+        rest += sock.recv(4096)
+    return head.split(b"\r\n")[0].decode()
+
+
+@pytest.fixture
+def conn_server(tmp_path):
+    """Factory fixture: arm the given ``HPNN_CONN_*`` knobs plus a
+    JSONL sink, boot ``make_server`` over an empty Session on a
+    thread, return ``(server, port, sink)``.  Teardown closes the
+    server (pairing every open in the sink), the session, the sink,
+    and restores the knob env + the module memo."""
+    saved = {k: os.environ.pop(k, None) for k in KNOBS}
+    booted = []
+    sink = str(tmp_path / "conn_sink.jsonl")
+
+    def boot(**knobs):
+        for k, v in knobs.items():
+            os.environ[k] = str(v)
+        conn._reset_for_tests()
+        obs.configure(sink)
+        sess = serve.Session(max_batch=4, n_buckets=1,
+                             max_wait_ms=0.5)
+        server = serve.make_server(sess, port=0)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        booted.append((server, sess))
+        return server, server.server_address[1], sink
+
+    yield boot
+    for server, sess in booted:
+        server.shutdown()
+        server.server_close()
+        sess.close()
+    obs.configure(None)
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    conn._reset_for_tests()
+
+
+# ---------------------------------------------------------- deadlines
+def test_deadline_phase_header_vs_body(conn_server):
+    """The same ``timeout`` reason, two distinguishable deaths: a
+    client stalled mid-HEADER closes with ``phase == "header"``, one
+    stalled mid-BODY with ``phase == "body"`` — the close record says
+    where the deadline hit, not just that one did."""
+    _, port, sink = conn_server(HPNN_CONN_HDR_MS=300,
+                                HPNN_CONN_BODY_MS=300,
+                                HPNN_CONN_TABLE=64)
+    # mid-header: request line complete, header block never finishes
+    hdr = socket.create_connection(("127.0.0.1", port), timeout=8.0)
+    hdr.sendall(b"GET /healthz HTTP/1.1\r\nHost: t\r\nX-Stall")
+    # mid-body: headers complete, 64-byte claim, 7 bytes delivered
+    body = socket.create_connection(("127.0.0.1", port), timeout=8.0)
+    body.sendall(b"POST /v1/infer HTTP/1.1\r\nHost: t\r\n"
+                 b"Content-Type: application/json\r\n"
+                 b"Content-Length: 64\r\n\r\n"
+                 b'{"kern')
+    try:
+        assert _recv_eof(hdr), "header deadline never closed the conn"
+        assert _recv_eof(body), "body deadline never closed the conn"
+        closes = _wait(lambda: (lambda c: c if len(c) >= 2 else None)(
+            _records(sink, "conn.close")))
+        assert closes, "no conn.close records reached the sink"
+        assert all(r["reason"] == "timeout" for r in closes), closes
+        assert sorted(r["phase"] for r in closes) == \
+            ["body", "header"], closes
+        # the body-phase record proves bytes were counted on arrival
+        by_phase = {r["phase"]: r for r in closes}
+        assert by_phase["body"]["bytes_in"] > \
+            by_phase["header"]["bytes_in"]
+    finally:
+        hdr.close()
+        body.close()
+
+
+def test_torn_body_is_not_a_timeout(conn_server):
+    """A client that vanishes mid-upload (short read vs its own
+    Content-Length) is a ``torn_body`` close in phase ``body`` — a
+    different forensic signature from the stalled-but-connected
+    ``timeout``."""
+    _, port, sink = conn_server(HPNN_CONN_HDR_MS=2000,
+                                HPNN_CONN_BODY_MS=2000,
+                                HPNN_CONN_TABLE=64)
+    s = socket.create_connection(("127.0.0.1", port), timeout=8.0)
+    s.sendall(b"POST /v1/infer HTTP/1.1\r\nHost: t\r\n"
+              b"Content-Type: application/json\r\n"
+              b"Content-Length: 400\r\n\r\n"
+              b'{"kernel": "nope"')
+    time.sleep(0.05)
+    s.close()
+    closes = _wait(lambda: _records(sink, "conn.close") or None)
+    assert closes, "no conn.close record reached the sink"
+    assert closes[0]["reason"] == "torn_body", closes
+    assert closes[0]["phase"] == "body", closes
+
+
+# ------------------------------------------------------------ per-IP
+def test_per_ip_cap_refuses_then_readmits(conn_server):
+    """With ``HPNN_CONN_PER_IP=2``: two live connections hold the cap,
+    the third is refused at admit time (a fully counted
+    ``guard``/``per_ip_cap`` close in phase ``admit``, zero bytes ever
+    read) — and the moment one of the two closes, the next connection
+    is admitted and served."""
+    server, port, sink = conn_server(HPNN_CONN_PER_IP=2,
+                                     HPNN_CONN_HDR_MS=60000,
+                                     HPNN_CONN_TABLE=64)
+    c1 = socket.create_connection(("127.0.0.1", port), timeout=8.0)
+    c2 = socket.create_connection(("127.0.0.1", port), timeout=8.0)
+    c3 = c4 = None
+    try:
+        assert _get(c1).endswith("200 OK")
+        assert _get(c2).endswith("200 OK")
+        assert _wait(lambda: conn.connz_doc(server)["active"] == 2
+                     or None), conn.connz_doc(server)
+        # third connection from the same IP: refused at the door
+        c3 = socket.create_connection(("127.0.0.1", port),
+                                      timeout=8.0)
+        assert _recv_eof(c3), "per-IP cap never closed the 3rd conn"
+        refusals = _wait(lambda: [
+            r for r in _records(sink, "conn.close")
+            if r["reason"] == "guard"] or None)
+        assert refusals, "refusal was not a counted close"
+        assert refusals[0]["phase"] == "admit", refusals
+        assert refusals[0]["detail"] == "per_ip_cap", refusals
+        assert refusals[0]["bytes_in"] == 0, refusals
+        # free one slot; the handler notices the EOF and finishes
+        c1.close()
+        assert _wait(lambda: conn.connz_doc(server)["active"] == 1
+                     or None), conn.connz_doc(server)
+        # ...and the next connection is admitted and served
+        c4 = socket.create_connection(("127.0.0.1", port),
+                                      timeout=8.0)
+        assert _get(c4).endswith("200 OK")
+    finally:
+        for c in (c1, c2, c3, c4):
+            if c is not None:
+                c.close()
+
+
+# ------------------------------------------------------------- drain
+def test_drain_closes_idle_keepalive_with_reason(conn_server):
+    """``drain_server`` sweeps idle keep-alive holders: the parked
+    connection is closed with reason ``drain`` (phase ``idle``), the
+    client sees EOF, and nothing is left unaccounted."""
+    server, port, sink = conn_server(HPNN_CONN_HDR_MS=60000,
+                                     HPNN_CONN_TABLE=64)
+    s = socket.create_connection(("127.0.0.1", port), timeout=8.0)
+    try:
+        assert _get(s).endswith("200 OK")
+        # the handler is back on its keep-alive readline; wait for the
+        # census to show the connection parked idle
+        doc = _wait(lambda: (lambda d: d if d["conns"] and all(
+            c["phase"] == "idle" for c in d["conns"]) else None)(
+            conn.connz_doc(server)))
+        assert doc, conn.connz_doc(server)
+        assert conn.drain_server(server) == 1
+        assert _recv_eof(s), "drain never closed the idle conn"
+        closes = _wait(lambda: _records(sink, "conn.close") or None)
+        assert closes, "no conn.close record reached the sink"
+        assert closes[0]["reason"] == "drain", closes
+        assert closes[0]["phase"] == "idle", closes
+        assert closes[0]["requests"] == 1, closes
+        assert _wait(lambda: conn.connz_doc(server)["active"] == 0
+                     or None) is not None
+    finally:
+        s.close()
+
+
+# ------------------------------------------------------------- census
+def test_connz_bounded_table_degrades_gracefully(conn_server):
+    """With ``HPNN_CONN_TABLE=2`` and three live connections: the
+    census keeps exact aggregates (active/opened) while the row table
+    stays capped at 2 with the overflow counted as ``untracked`` —
+    and untracked connections are still served and still close
+    counted."""
+    server, port, sink = conn_server(HPNN_CONN_TABLE=2,
+                                     HPNN_CONN_HDR_MS=60000)
+    socks = [socket.create_connection(("127.0.0.1", port),
+                                      timeout=8.0) for _ in range(3)]
+    try:
+        for s in socks:
+            assert _get(s).endswith("200 OK")
+        doc = _wait(lambda: (lambda d: d
+                             if d["active"] == 3 else None)(
+            conn.connz_doc(server)))
+        assert doc, conn.connz_doc(server)
+        assert doc["opened"] == 3
+        assert doc["table"]["max"] == 2
+        assert doc["table"]["rows"] <= 2
+        assert doc["table"]["untracked"] >= 1
+        assert len(doc["conns"]) <= 2
+        # the /connz route itself serves the same census (this GET is
+        # a 4th connection — the aggregates move, the cap holds)
+        assert _get(socks[0], "/connz").endswith("200 OK")
+        # every open is gauge-visible even past the table bound
+        gauges = _records(sink, "conn.active")
+        assert gauges and max(g["value"] for g in gauges) >= 3
+    finally:
+        for s in socks:
+            s.close()
+        # every close — including the untracked connection's — must
+        # still be counted
+        assert _wait(
+            lambda: len(_records(sink, "conn.close")) >= 3 or None)

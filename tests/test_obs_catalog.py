@@ -534,3 +534,137 @@ def test_main_drift_flag_exit_codes(tmp_path, capsys):
     assert mod.main(["--drift", str(path)]) == 1
     assert mod.main(["--drift"]) == 2
     capsys.readouterr()
+
+
+# ------------------------------------------------- connection plane
+def _conn_rows():
+    """A well-formed conn-armed run: one clean keep-alive connection,
+    one guard-killed slowloris, one per-IP-cap refusal (admit-time
+    close with no byte ledger)."""
+    return [
+        {"ev": "conn.open", "kind": "count", "n": 1, "total": 1,
+         "id": "p-c1", "ip": "127.0.0.1", "port": 40001,
+         "plane": "serve"},
+        {"ev": "conn.active", "kind": "gauge", "value": 1,
+         "plane": "serve"},
+        {"ev": "conn.oldest_s", "kind": "gauge", "value": 0.0,
+         "plane": "serve"},
+        {"ev": "conn.open", "kind": "count", "n": 1, "total": 2,
+         "id": "p-c2", "ip": "10.0.0.9", "port": 40002,
+         "plane": "serve"},
+        {"ev": "conn.open", "kind": "count", "n": 1, "total": 3,
+         "id": "p-c3", "ip": "10.0.0.9", "port": 40003,
+         "plane": "serve"},
+        {"ev": "conn.close", "kind": "count", "n": 1, "total": 1,
+         "id": "p-c3", "reason": "guard", "detail": "per_ip_cap",
+         "plane": "serve", "bytes_in": 0, "bytes_out": 0,
+         "requests": 0, "duration_s": 0.0, "phase": "admit"},
+        {"ev": "conn.guard_kill", "kind": "count", "n": 1, "total": 1,
+         "reason": "slowloris", "id": "p-c2", "ip": "10.0.0.9",
+         "plane": "serve"},
+        {"ev": "conn.guard_kills", "kind": "gauge", "value": 1,
+         "plane": "serve"},
+        {"ev": "conn.close", "kind": "count", "n": 1, "total": 2,
+         "id": "p-c2", "reason": "guard", "plane": "serve",
+         "bytes_in": 41, "bytes_out": 0, "requests": 0,
+         "duration_s": 2.04, "phase": "header"},
+        {"ev": "conn.close", "kind": "count", "n": 1, "total": 3,
+         "id": "p-c1", "reason": "eof", "plane": "serve",
+         "bytes_in": 380, "bytes_out": 912, "requests": 3,
+         "duration_s": 1.5, "phase": "idle"},
+        {"ev": "conn.active", "kind": "gauge", "value": 0,
+         "plane": "serve"},
+    ]
+
+
+def test_conn_lint_accepts_a_well_formed_sink(tmp_path):
+    mod = _load()
+    path = tmp_path / "conn.jsonl"
+    _write_sink(path, _conn_rows())
+    assert mod.lint_conn(str(path)) == []
+
+
+def test_conn_lint_catches_every_schema_break(tmp_path):
+    """Each clause bites: wrong kind, bad increment, empty id, reused
+    open id, orphan close, double close, unknown close reason,
+    negative byte ledger, NaN duration, unknown kill reason, a kill
+    naming no open, and a NaN gauge."""
+    mod = _load()
+    path = tmp_path / "conn.jsonl"
+    base = _conn_rows()
+    breaks = [
+        ({"ev": "conn.open", "kind": "gauge", "n": 1, "id": "p-x",
+          "ip": "1.2.3.4", "plane": "serve"}, "!= 'count'"),
+        ({"ev": "conn.open", "kind": "count", "n": 0, "id": "p-x",
+          "ip": "1.2.3.4", "plane": "serve"}, "positive int"),
+        ({"ev": "conn.open", "kind": "count", "n": 1, "id": "",
+          "ip": "1.2.3.4", "plane": "serve"}, "non-empty string"),
+        ({"ev": "conn.open", "kind": "count", "n": 1, "id": "p-c1",
+          "ip": "1.2.3.4", "plane": "serve"}, "reused"),
+        ({"ev": "conn.close", "kind": "count", "n": 1, "id": "ghost",
+          "reason": "eof", "bytes_in": 0, "bytes_out": 0,
+          "requests": 0, "phase": "idle"}, "unadmitted"),
+        ({"ev": "conn.close", "kind": "count", "n": 1, "id": "p-c1",
+          "reason": "eof", "bytes_in": 0, "bytes_out": 0,
+          "requests": 0, "phase": "idle"}, "closed twice"),
+        ({"ev": "conn.close", "kind": "count", "n": 1, "id": "p-c2",
+          "reason": "vibes", "bytes_in": 0, "bytes_out": 0,
+          "requests": 0, "phase": "idle"}, "reason"),
+        ({"ev": "conn.close", "kind": "count", "n": 1, "id": "p-c2",
+          "reason": "eof", "bytes_in": -4, "bytes_out": 0,
+          "requests": 0, "phase": "idle"}, "non-negative int"),
+        ({"ev": "conn.close", "kind": "count", "n": 1, "id": "p-c2",
+          "reason": "eof", "bytes_in": 0, "bytes_out": 0,
+          "requests": 0, "duration_s": float("nan"),
+          "phase": "idle"}, "duration_s"),
+        ({"ev": "conn.guard_kill", "kind": "count", "n": 1,
+          "reason": "vibes", "id": "p-c1", "plane": "serve"},
+         "slowloris/stall"),
+        ({"ev": "conn.guard_kill", "kind": "count", "n": 1,
+          "reason": "stall", "id": "ghost", "plane": "serve"},
+         "names no opened"),
+        ({"ev": "conn.active", "kind": "gauge",
+          "value": float("nan"), "plane": "serve"},
+         "finite non-negative"),
+    ]
+    for rec, needle in breaks:
+        # appended after a valid run so the pairing state is primed
+        # (a double close needs p-c1 already closed, etc.)
+        _write_sink(path, base + [rec])
+        failures = mod.lint_conn(str(path))
+        assert failures, f"schema break not caught: {rec}"
+        assert any(needle in f for f in failures), (needle, failures)
+
+
+def test_conn_lint_fails_a_leaked_open(tmp_path):
+    """An open with no paired close means the sink lost a death —
+    server shutdown drains leftovers, so a leak is a real bug."""
+    mod = _load()
+    path = tmp_path / "conn.jsonl"
+    _write_sink(path, _conn_rows() + [
+        {"ev": "conn.open", "kind": "count", "n": 1, "id": "p-c9",
+         "ip": "127.0.0.1", "port": 40009, "plane": "serve"},
+    ])
+    assert any("without a paired conn.close" in f
+               for f in mod.lint_conn(str(path)))
+
+
+def test_conn_lint_fails_an_unarmed_sink(tmp_path):
+    mod = _load()
+    path = tmp_path / "quiet.jsonl"
+    _write_sink(path, [{"ev": "obs.summary", "kind": "summary"}])
+    assert any("no conn.* records" in f
+               for f in mod.lint_conn(str(path)))
+
+
+def test_main_conn_flag_exit_codes(tmp_path, capsys):
+    mod = _load()
+    path = tmp_path / "conn.jsonl"
+    _write_sink(path, _conn_rows())
+    assert mod.main(["--conn", str(path)]) == 0
+    _write_sink(path, [{"ev": "conn.close", "kind": "count", "n": 1,
+                        "id": "ghost", "reason": "eof",
+                        "phase": "idle"}])
+    assert mod.main(["--conn", str(path)]) == 1
+    assert mod.main(["--conn"]) == 2
+    capsys.readouterr()

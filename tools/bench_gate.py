@@ -195,6 +195,19 @@ GATE_METRICS = {
     "blame_overhead_pct": ("lower", 2.00),
     "drill_tune_applies": ("higher", 0.01),
     "drill_tune_rollback_bitwise": ("higher", 0.01),
+    # connection-plane fold-ins (bench.py bench_conn_overhead +
+    # tools/chaos_drill.py run_bench_torn_drill; docs/serving.md
+    # "Connection plane", docs/resilience.md): the paired marginal
+    # cost of the armed socket guards on the HTTP serve path
+    # (acceptance bar <=5% — medians hover near zero, so the
+    # tolerance is wide like the other overhead gates), the clean
+    # traffic's goodput dip while hostile clients attack (acceptance
+    # ceiling 10%), and how many clean requests were LOST outright
+    # (acceptance is zero; the wide tolerance only tolerates noise
+    # around an already-zero baseline)
+    "conn_overhead_pct": ("lower", 2.00),
+    "drill_torn_dip_pct": ("lower", 1.00),
+    "drill_torn_clean_lost": ("lower", 2.00),
 }
 
 

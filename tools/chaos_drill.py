@@ -102,15 +102,28 @@ catalog (docs/resilience.md):
   with ``tools/check_obs_catalog.py --tune`` passing over the
   drill's own sink.
 
+* **torn** — the connection plane's live proof (docs/serving.md
+  "Connection plane"): a conn-guarded server (``HPNN_CONN_*``) under
+  clean loadgen traffic is attacked by ``loadgen.run_hostile``
+  slowloris / torn-body / fuzz clients with a ``conn.guard_kills``
+  threshold rule and a capsule dir armed.  Asserts clean goodput
+  dips ≤ 10% with ZERO clean lost, every hostile connection is
+  accounted by close reason (``guard``/``torn_body``/``fuzz``/
+  ``timeout``/``reset``), every slowloris is guard-killed and the
+  kill fires the alert → a capsule carrying ``conn.json``, the
+  drill's own sink passes ``check_obs_catalog.py --conn``, and no
+  attacker thread is left hung.
+
 Outcome rows are JSONL (``--out``) with ``ev`` = ``drill.kill9`` |
 ``drill.reload`` | ``drill.sentinel`` | ``drill.replica`` |
 ``drill.alert`` | ``drill.worker`` | ``drill.capsule`` |
-``drill.drift`` | ``drill.quota`` | ``drill.hog`` | ``drill.tune``;
-:func:`run_bench_drill` /
+``drill.drift`` | ``drill.quota`` | ``drill.hog`` | ``drill.tune`` |
+``drill.torn``; :func:`run_bench_drill` /
 :func:`run_bench_replica_drill` / :func:`run_bench_alert_drill` /
 :func:`run_bench_worker_drill` / :func:`run_bench_capsule_drill` /
 :func:`run_bench_drift_drill` / :func:`run_bench_quota_drill` /
-:func:`run_bench_hog_drill` / :func:`run_bench_tune_drill` are
+:func:`run_bench_hog_drill` / :func:`run_bench_tune_drill` /
+:func:`run_bench_torn_drill` are
 the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_goodput_dip_pct`` / ``drill_lost_requests`` /
 ``drill_replica_dip_pct`` / ``drill_replica_survivors_lost`` /
@@ -119,7 +132,8 @@ the bench.py fold-ins (compact keys ``drill_recovery_s`` /
 ``drill_capsule_capture_s`` / ``drill_capsule_blame_pct`` /
 ``drill_drift_detect_s`` / ``drill_quota_victim_goodput_ratio`` /
 ``drill_hog_blame_pct`` / ``drill_hog_detect_s`` /
-``drill_tune_applies`` / ``drill_tune_rollback_bitwise``, gated by
+``drill_tune_applies`` / ``drill_tune_rollback_bitwise`` /
+``drill_torn_dip_pct`` / ``drill_torn_clean_lost``, gated by
 ``tools/bench_gate.py``).  Skips cleanly (``"skipped"``) when the
 child cannot start.
 
@@ -1756,6 +1770,175 @@ def drill_tune(workdir: str, *, rate: float = 0.0, seed: int = 13,
                 os.environ[key] = val
 
 
+def drill_torn(workdir: str, *, rate: float = 30.0, seed: int = 8,
+               n_hostile: int = 3) -> dict:
+    """The connection plane's live proof (docs/serving.md "Connection
+    plane"): a conn-guarded in-process serve Session under clean
+    loadgen traffic, then ``n_hostile`` attackers of EACH hostile
+    class at once — slowloris header-tricklers (the byte-rate guard's
+    prey), torn-body clients (Content-Length declared, peer hangs up
+    mid-body), and fuzz clients (garbage request lines).  Asserts the
+    blast radius stayed on the attackers: clean goodput dip ≤ 10%
+    with zero clean ``lost``, every hostile connection accounted by
+    close reason in the drill's own sink, every slowloris
+    guard-killed (``conn.guard_kill reason=slowloris``) with the
+    armed ``conn.guard_kills`` rule firing → a capture capsule whose
+    ``conn.json`` carries the census, ``/connz`` live throughout,
+    the sink passing ``check_obs_catalog.py --conn``, and no
+    attacker thread hung (``drill_torn_dip_pct`` /
+    ``drill_torn_clean_lost`` in bench_gate.py)."""
+    from hpnn_tpu import obs
+    from hpnn_tpu.models import kernel as kernel_mod
+    from hpnn_tpu.serve import Session, conn as conn_mod, make_server
+
+    import check_obs_catalog
+    import loadgen
+
+    _shield_sigpipe()
+    out: dict = {"ev": "drill.torn", "ok": False,
+                 "n_hostile": 3 * int(n_hostile)}
+    sink = os.path.join(workdir, "torn-drill.metrics.jsonl")
+    capsule_dir = os.path.join(workdir, "capsules")
+    env_keys = ("HPNN_CONN_HDR_MS", "HPNN_CONN_BODY_MS",
+                "HPNN_CONN_PER_IP", "HPNN_CONN_MIN_BPS",
+                "HPNN_CONN_TABLE", "HPNN_ALERTS", "HPNN_CAPSULE_DIR",
+                "HPNN_CAPSULE_COOLDOWN_S", "HPNN_METRICS")
+    prev_env = {key: os.environ.get(key) for key in env_keys}
+    # generous deadlines + per-IP room: every guard must be armed,
+    # but CLEAN traffic (8 keep-alive loadgen workers, same IP as
+    # the attackers) must never trip one — the drill measures guard
+    # selectivity, not just guard existence
+    os.environ["HPNN_CONN_HDR_MS"] = "4000"
+    os.environ["HPNN_CONN_BODY_MS"] = "4000"
+    os.environ["HPNN_CONN_PER_IP"] = "64"
+    os.environ["HPNN_CONN_MIN_BPS"] = "256"
+    os.environ["HPNN_CONN_TABLE"] = "256"
+    os.environ["HPNN_CAPSULE_DIR"] = capsule_dir
+    os.environ["HPNN_CAPSULE_COOLDOWN_S"] = "0"
+    os.environ["HPNN_ALERTS"] = ("conn_guard@conn.guard_kills>0:"
+                                 "for=0,cooldown=0,severity=warn")
+    k, _ = kernel_mod.generate(7, 8, [5], 2)
+    session = server = None
+
+    def _manifest():
+        for dirpath, _dirs, files in os.walk(capsule_dir):
+            if "manifest.json" in files:
+                return os.path.join(dirpath, "manifest.json")
+        return None
+
+    try:
+        # warm compile BEFORE arming obs, the drill_capsule discipline
+        session = Session(max_batch=16, n_buckets=2, max_wait_ms=0.5)
+        session.register_kernel(KERNEL, k)
+        warm = np.linspace(-1.0, 1.0, 8)
+        for _ in range(3):
+            session.infer(KERNEL, warm, timeout_s=10.0)
+        obs.configure(sink)           # re-arms alerts + capsule hook
+        conn_mod._reset_for_tests()   # re-reads the HPNN_CONN_* knobs
+        server = make_server(session)
+        port = server.server_address[1]
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{port}"
+        load = _Load(port, rate=rate, ingest_frac=0.0, seed=seed)
+        time.sleep(2.5)               # clean baseline bins
+        t_attack = load.now()
+        hostile: dict[str, dict] = {}
+        h_lock = threading.Lock()
+
+        def attack(mode: str):
+            s = loadgen.run_hostile(
+                url, mode=mode, n_conns=n_hostile, duration_s=6.0,
+                interval_s=0.3, seed=seed)
+            with h_lock:
+                hostile[mode] = s
+
+        attackers = [threading.Thread(target=attack, args=(m,),
+                                      daemon=True)
+                     for m in loadgen.HOSTILE_MODES]
+        for t in attackers:
+            t.start()
+        fired = _wait(
+            lambda: (obs.alerts.health_doc().get("fired_total")
+                     or None), 15.0, interval_s=0.05)
+        t_fire = load.now()
+        manifest_path = _wait(_manifest, 10.0, interval_s=0.05)
+        for t in attackers:
+            t.join(timeout=15.0)
+        code, connz = http_get(port, "/connz", timeout_s=2.0)
+        records = load.finish(settle_s=1.0)
+        server.shutdown()             # table.close drains leftovers,
+        server.server_close()         # pairing every open in the sink
+        server = None
+        obs.configure(None)           # close the sink for the audit
+        out.update(blast_radius(records, t_attack))
+        out["clean_lost"] = out.pop("lost")
+        out["hostile"] = hostile
+        out["hung"] = sum(s.get("hung", 0) for s in hostile.values())
+        out["fired"] = bool(fired)
+        out["fire_s"] = round(t_fire - t_attack, 3) if fired else None
+        out["connz_active"] = (connz or {}).get("active")
+        man = {}
+        if manifest_path:
+            with open(manifest_path) as fp:
+                man = json.load(fp)
+            out["capsule"] = man.get("capsule")
+            conn_json = os.path.join(os.path.dirname(manifest_path),
+                                     "conn.json")
+            out["capsule_conn"] = os.path.exists(conn_json)
+        else:
+            out["capsule_conn"] = False
+        closes: dict[str, int] = {}
+        kills: dict[str, int] = {}
+        with open(sink) as fp:
+            for line in fp:
+                rec = json.loads(line)
+                if rec.get("ev") == "conn.close":
+                    r = rec.get("reason", "?")
+                    closes[r] = closes.get(r, 0) + 1
+                elif rec.get("ev") == "conn.guard_kill":
+                    r = rec.get("reason", "?")
+                    kills[r] = kills.get(r, 0) + 1
+        out["close_reasons"] = dict(sorted(closes.items()))
+        out["guard_kills"] = dict(sorted(kills.items()))
+        # every hostile connection must land in a hostile close class
+        # (clean keep-alive conns close eof/drain); reset absorbs the
+        # races where the peer's FIN beats the short body read
+        hostile_accounted = sum(
+            closes.get(r, 0) for r in
+            ("guard", "torn_body", "fuzz", "timeout", "reset"))
+        out["hostile_accounted"] = hostile_accounted
+        lint = check_obs_catalog.lint_conn(sink)
+        out["lint_failures"] = lint
+        slow = hostile.get("slowloris", {}).get("outcomes", {})
+        out["ok"] = bool(
+            out["goodput_dip_pct"] is not None
+            and out["goodput_dip_pct"] <= 10.0
+            and out["clean_lost"] == 0
+            and out["hung"] == 0
+            and hostile_accounted >= 3 * int(n_hostile)
+            and slow.get("killed", 0) == int(n_hostile)
+            and kills.get("slowloris", 0) >= int(n_hostile)
+            and out["fired"]
+            and out["capsule_conn"]
+            and isinstance(out["connz_active"], int)
+            and not lint)
+        return out
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if session is not None:
+            session.close()
+        obs.configure(None)
+        for key, val in prev_env.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        conn_mod._reset_for_tests()
+
+
 DRILLS = {
     "kill9": drill_kill9,
     "reload": drill_reload,
@@ -1768,6 +1951,7 @@ DRILLS = {
     "quota": drill_quota,
     "hog": drill_hog,
     "tune": drill_tune,
+    "torn": drill_torn,
 }
 
 
@@ -1964,6 +2148,28 @@ def run_bench_tune_drill(*, rate: float = 0.0) -> dict:
     return out
 
 
+def run_bench_torn_drill(*, rate: float = 30.0) -> dict:
+    """The bench.py fold-in for the torn drill: the hostile-network
+    attack classes against a conn-guarded server under clean load,
+    reported as gateable numbers (``drill_torn_dip_pct`` — clean
+    goodput dip while under attack — and ``drill_torn_clean_lost``)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as tmp:
+        row = drill_torn(tmp, rate=rate)
+    out = {
+        "metric": "torn_drill",
+        "drill": row,
+        "dip_pct": row.get("goodput_dip_pct"),
+        "clean_lost": row.get("clean_lost"),
+        "hostile_accounted": row.get("hostile_accounted"),
+        "guard_kills": row.get("guard_kills"),
+        "ok": row.get("ok", False),
+    }
+    if "skipped" in row:
+        out["skipped"] = row["skipped"]
+    return out
+
+
 def run_bench_hog_drill(*, rate: float = 12.0) -> dict:
     """The bench.py fold-in for the hog drill: one tenant at 20x the
     zipf head's rate under an armed meter, reported as gateable
@@ -1992,11 +2198,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="chaos drills against a live online_nn child "
                     "(kill9 / reload / sentinel / replica / alert / "
-                    "worker / capsule / drift / quota / hog / tune)")
+                    "worker / capsule / drift / quota / hog / tune / "
+                    "torn)")
     ap.add_argument("--drill", default="all",
                     choices=("all", "kill9", "reload", "sentinel",
                              "replica", "alert", "worker", "capsule",
-                             "drift", "quota", "hog", "tune"))
+                             "drift", "quota", "hog", "tune", "torn"))
     ap.add_argument("--rate", type=float, default=40.0,
                     help="loadgen offered load during the drill")
     ap.add_argument("--workdir",

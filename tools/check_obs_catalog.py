@@ -153,13 +153,24 @@ verdict is off the closed enum, or a blame-share gauge outside
 reversible" claim unverifiable, so their shapes are frozen too
 (docs/selftuning.md).
 
+And the connection-plane schema lint (:func:`lint_conn`): the
+``conn.open`` / ``conn.close`` / ``conn.guard_kill`` records
+(hpnn_tpu/serve/conn.py, HPNN_CONN_*) are the wire-level account of
+who connected and how it ended — an open without its paired close
+(same ``id``) is a leaked connection the census can't see, a close
+reason off the closed enum is an unclassifiable death, a guard kill
+outside slowloris/stall is a guard nobody documented, and a
+non-finite ``conn.active`` / ``conn.oldest_s`` gauge poisons the
+alert rules watching them — so their shapes are frozen too
+(docs/serving.md "Connection plane").
+
 Run standalone (exit code for CI)::
 
     python tools/check_obs_catalog.py [--ledger PATH] [--perf PATH]
         [--slo PATH] [--online PATH] [--quant PATH] [--chaos PATH]
         [--serve-replicas PATH] [--fleet PATH] [--cluster PATH]
         [--forensics PATH] [--drift PATH] [--tenant PATH]
-        [--meter PATH] [--tune PATH]
+        [--meter PATH] [--tune PATH] [--conn PATH]
 
 or via the tier-1 suite (tests/test_obs_catalog.py).  stdlib-only.
 """
@@ -904,7 +915,7 @@ CHAOS_ACTIONS = ("kill", "raise", "delay", "nan")
 WAL_SKIP_REASONS = ("sig", "torn", "magic")
 DRILL_EVS = ("drill.kill9", "drill.reload", "drill.sentinel",
              "drill.replica", "drill.alert", "drill.worker",
-             "drill.capsule", "drill.drift")
+             "drill.capsule", "drill.drift", "drill.torn")
 
 
 def lint_chaos(path: str) -> list[str]:
@@ -2344,6 +2355,156 @@ def lint_tune(path: str) -> list[str]:
     return failures
 
 
+# the connection-plane record contracts (hpnn_tpu/serve/conn.py,
+# HPNN_CONN_*; docs/serving.md "Connection plane")
+CONN_CLOSE_REASONS = ("eof", "timeout", "reset", "torn_body", "fuzz",
+                      "drain", "guard")
+CONN_KILL_REASONS = ("slowloris", "stall")
+CONN_GAUGES = ("conn.active", "conn.oldest_s", "conn.guard_kills")
+
+
+def lint_conn(path: str) -> list[str]:
+    """Schema-lint the connection-plane records of one metrics sink
+    (a run with any ``HPNN_CONN_*`` knob armed — docs/serving.md
+    "Connection plane").
+
+    Checks, per record:
+
+    * ``conn.open`` — ``kind == "count"``, positive ``n``, non-empty
+      string ``id`` never opened before (a reused id merges two
+      connections' ledgers), non-empty ``ip``/``plane``.
+    * ``conn.close`` — same count shape; its ``id`` must pair a
+      previously opened, not-yet-closed connection (an orphan close
+      accounts a connection nobody admitted; a double close counts
+      one death twice); ``reason`` on the closed enum
+      eof/timeout/reset/torn_body/fuzz/drain/guard;
+      ``bytes_in``/``bytes_out``/``requests`` non-negative ints and
+      ``duration_s`` finite >= 0 when present (per-IP-cap refusals
+      close at admission with none of them).
+    * ``conn.guard_kill`` — count shape; ``reason`` in
+      slowloris/stall; its ``id`` must name an opened connection.
+    * ``conn.active`` / ``conn.oldest_s`` / ``conn.guard_kills``
+      gauges — finite non-negative values (a NaN here poisons the
+      alert rules watching the census).
+
+    Connections still open at EOF fail: ``_Table.close`` pairs every
+    leftover with a ``drain`` close on server shutdown, so an
+    unpaired open means the sink lost a death.  A sink with no
+    ``conn.*`` records fails — this lint only makes sense on a
+    conn-armed run.  Returns failure strings (empty = pass)."""
+    import json
+    import math
+
+    failures: list[str] = []
+    try:
+        with open(path) as fp:
+            lines = [ln for ln in fp if ln.strip()]
+    except OSError as exc:
+        return [f"cannot read sink {path!r}: {exc}"]
+    n_conn = 0
+    opened: set[str] = set()
+    closed: set[str] = set()
+    for i, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail line — load_events skips these too
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("ev")
+        at = f"record {i + 1}"
+        if ev in ("conn.open", "conn.close", "conn.guard_kill"):
+            n_conn += 1
+            if rec.get("kind") != "count":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'count'")
+            if not _pos_int(rec.get("n")):
+                failures.append(
+                    f"{at}: {ev} increment {rec.get('n')!r} is not a "
+                    "positive int")
+            cid = rec.get("id")
+            if not isinstance(cid, str) or not cid:
+                failures.append(
+                    f"{at}: {ev} id {cid!r} is not a non-empty "
+                    "string")
+                continue
+            if ev == "conn.open":
+                if cid in opened:
+                    failures.append(
+                        f"{at}: conn.open id {cid!r} reused — two "
+                        "connections share one ledger")
+                opened.add(cid)
+                for key in ("ip", "plane"):
+                    v = rec.get(key)
+                    if not isinstance(v, str) or not v:
+                        failures.append(
+                            f"{at}: conn.open {key} {v!r} is not a "
+                            "non-empty string")
+            elif ev == "conn.close":
+                if cid not in opened:
+                    failures.append(
+                        f"{at}: conn.close id {cid!r} pairs no "
+                        "conn.open — an unadmitted death")
+                elif cid in closed:
+                    failures.append(
+                        f"{at}: conn.close id {cid!r} closed twice")
+                closed.add(cid)
+                r = rec.get("reason")
+                if r not in CONN_CLOSE_REASONS:
+                    failures.append(
+                        f"{at}: conn.close reason {r!r} not in "
+                        f"{'/'.join(CONN_CLOSE_REASONS)}")
+                for key in ("bytes_in", "bytes_out", "requests"):
+                    v = rec.get(key)
+                    if v is None:
+                        continue  # per-IP-cap refusal: admit-time close
+                    if (not isinstance(v, int) or isinstance(v, bool)
+                            or v < 0):
+                        failures.append(
+                            f"{at}: conn.close {key} {v!r} is not a "
+                            "non-negative int")
+                d = rec.get("duration_s")
+                if d is not None and (not _num(d)
+                                      or not math.isfinite(d)
+                                      or d < 0):
+                    failures.append(
+                        f"{at}: conn.close duration_s {d!r} is not a "
+                        "finite non-negative number")
+            else:
+                if rec.get("reason") not in CONN_KILL_REASONS:
+                    failures.append(
+                        f"{at}: conn.guard_kill reason "
+                        f"{rec.get('reason')!r} not in "
+                        f"{'/'.join(CONN_KILL_REASONS)}")
+                if cid not in opened:
+                    failures.append(
+                        f"{at}: conn.guard_kill id {cid!r} names no "
+                        "opened connection")
+        elif ev in CONN_GAUGES:
+            n_conn += 1
+            if rec.get("kind") != "gauge":
+                failures.append(
+                    f"{at}: {ev} kind {rec.get('kind')!r} != 'gauge'")
+            v = rec.get("value")
+            if not _num(v) or not math.isfinite(v) or v < 0:
+                failures.append(
+                    f"{at}: {ev} value {v!r} is not a finite "
+                    "non-negative number")
+    leaked = opened - closed
+    if leaked:
+        sample = ", ".join(sorted(leaked)[:4])
+        failures.append(
+            f"sink {path!r}: {len(leaked)} conn.open without a "
+            f"paired conn.close ({sample}…) — every admitted "
+            "connection must account its death (server shutdown "
+            "drains leftovers with reason=drain)")
+    if not n_conn:
+        failures.append(
+            f"sink {path!r} has no conn.* records — was any "
+            "HPNN_CONN_* knob armed during this run?")
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -2443,6 +2604,13 @@ def main(argv: list[str] | None = None) -> int:
                              "path\n")
             return 2
         failures += lint_tune(argv[i + 1])
+    if "--conn" in argv:
+        i = argv.index("--conn")
+        if i + 1 >= len(argv):
+            sys.stderr.write("check_obs_catalog: --conn needs a "
+                             "path\n")
+            return 2
+        failures += lint_conn(argv[i + 1])
     if failures:
         for f in failures:
             sys.stderr.write(f"check_obs_catalog: FAIL: {f}\n")

@@ -214,6 +214,20 @@ def check(tmpdir: str) -> list[str]:
     # come out the other end having actually received the records
     from hpnn_tpu.obs import collector as collector_mod
 
+    # the connection plane (docs/serving.md "Connection plane") rides
+    # the same proof: every HPNN_CONN_* knob armed BEFORE the
+    # collector starts, so the round's live push traffic flows
+    # through the guarded socket path — deadlines, per-IP cap, byte-
+    # rate watchdog all attached — without moving stdout a byte
+    from hpnn_tpu.serve import conn as conn_mod
+
+    os.environ["HPNN_CONN_HDR_MS"] = "5000"
+    os.environ["HPNN_CONN_BODY_MS"] = "5000"
+    os.environ["HPNN_CONN_PER_IP"] = "64"
+    os.environ["HPNN_CONN_MIN_BPS"] = "1"
+    os.environ["HPNN_CONN_TABLE"] = "64"
+    conn_mod._reset_for_tests()
+
     coll_out = os.path.join(tmpdir, "collector_merged.jsonl")
     coll_server = collector_mod.start_collector(path=coll_out)
     coll_port = coll_server.server_address[1]
@@ -289,9 +303,13 @@ def check(tmpdir: str) -> list[str]:
                      "HPNN_SAMPLE", "HPNN_CAPSULE_DIR",
                      "HPNN_CAPSULE_PROFILE_MS",
                      "HPNN_CAPSULE_COOLDOWN_S", "HPNN_DRIFT",
-                     "HPNN_METER", "HPNN_BLAME", "HPNN_TUNE") \
+                     "HPNN_METER", "HPNN_BLAME", "HPNN_TUNE",
+                     "HPNN_CONN_HDR_MS", "HPNN_CONN_BODY_MS",
+                     "HPNN_CONN_PER_IP", "HPNN_CONN_MIN_BPS",
+                     "HPNN_CONN_TABLE") \
                 + tuple(k for k, _ in _ONLINE_KNOBS):
             os.environ.pop(knob, None)
+        conn_mod._reset_for_tests()
         chaos_mod._reset_for_tests()
         wal_mod._reset_for_tests()
         forensics_mod._reset_for_tests()
@@ -311,6 +329,7 @@ def check(tmpdir: str) -> list[str]:
             "(alert-triggered capture) + HPNN_DRIFT (armed "
             "sketches) + HPNN_METER (armed metering) + "
             "HPNN_BLAME + HPNN_TUNE (armed blame/tuning) + "
+            "HPNN_CONN_* (guarded collector sockets) + "
             "HPNN_ONLINE_* (incl. "
             "HPNN_ONLINE_SCAN_K) + "
             "HPNN_SERVE_DTYPE=bf16 + export server all enabled "

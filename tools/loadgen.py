@@ -38,6 +38,21 @@ server-side spans parent across the process boundary and the report
 stitches one client → edge → replica tree per request
 (docs/observability.md "Fleet telemetry").
 
+Hostile traffic: ``--hostile slowloris|torn|fuzz`` turns the tool
+into an attack-class generator against a conn-guarded server
+(``HPNN_CONN_*``, docs/serving.md "Connection plane") — raw sockets,
+no HTTP client library, because the whole point is to misbehave below
+the request layer.  ``slowloris`` trickles header bytes forever (one
+bogus header line per ``--interval``), ``torn`` declares a
+Content-Length and hangs up mid-body, ``fuzz`` sprays garbage where a
+request line should be.  Each mode reports its own outcome classes
+(slowloris: ``killed``/``answered``/``survived``; torn: ``torn``;
+fuzz: ``rejected``/``dropped``/``ignored``; all: ``refused``), plus a
+``hung`` count of attacker threads that failed to finish — the
+torn-network chaos drill (``tools/chaos_drill.py --drill torn``,
+docs/resilience.md) asserts ``survived == 0`` and ``hung == 0``
+while clean traffic keeps flowing.
+
 Multi-tenant traffic: ``--tenants N`` spreads requests over N
 synthetic tenants (``t000``..) drawn from a Zipf distribution
 (``--zipf S``, heavier S = hotter head — real tenant populations are
@@ -452,6 +467,162 @@ def _ingest_bodies(kernels, rows_choices, n_in: int, n_out: int,
                 {"kernel": k, "inputs": X.round(4).tolist(),
                  "targets": T.round(4).tolist()}).encode()
     return bodies
+
+
+# ------------------------------------------------------------ hostile
+
+
+HOSTILE_MODES = ("slowloris", "torn", "fuzz")
+
+
+def _hostile_target(url: str) -> tuple[str, int]:
+    u = urllib.parse.urlparse(url if "//" in url else "http://" + url)
+    return u.hostname or "127.0.0.1", u.port or 80
+
+
+def _attack_slowloris(host: str, port: int, *, duration_s: float,
+                      interval_s: float,
+                      stop: "threading.Event | None") -> str:
+    """Trickle header bytes and never finish the request.  Against an
+    unguarded server this pins a handler thread for ``duration_s``;
+    against ``HPNN_CONN_MIN_BPS`` / ``HPNN_CONN_HDR_MS`` the server
+    kills us first.  The recv timeout doubles as the trickle pacing:
+    per-recv socket timeouts never fire for a client that always sends
+    one more byte in time — which is exactly the defence bypass the
+    byte-rate floor exists to close."""
+    try:
+        sock = socket.create_connection((host, port), timeout=2.0)
+    except OSError:
+        return "refused"
+    try:
+        sock.sendall(b"POST /v1/infer HTTP/1.1\r\nHost: lg\r\n")
+        deadline = time.perf_counter() + duration_s
+        i = 0
+        while time.perf_counter() < deadline:
+            if stop is not None and stop.is_set():
+                break
+            sock.sendall(f"X-Slow-{i}: y\r\n".encode())
+            i += 1
+            sock.settimeout(max(0.05, interval_s))
+            try:
+                data = sock.recv(256)
+            except socket.timeout:
+                continue
+            # the server spoke first: an empty read is a guard/deadline
+            # kill, bytes are an early error response — either way the
+            # attack failed to pin the thread
+            return "killed" if not data else "answered"
+        return "survived"
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        return "killed"
+    finally:
+        try:
+            sock.close()
+        except OSError:  # already torn down
+            pass
+
+
+def _attack_torn(host: str, port: int, *, body_claim: int = 400,
+                 body_sent: int = 24) -> str:
+    """Declare a Content-Length, send a fraction of it, hang up.  The
+    server's body read comes up short (close reason ``torn_body``)."""
+    try:
+        sock = socket.create_connection((host, port), timeout=2.0)
+    except OSError:
+        return "refused"
+    try:
+        hdr = (b"POST /v1/infer HTTP/1.1\r\nHost: lg\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Content-Length: " + str(int(body_claim)).encode()
+               + b"\r\n\r\n")
+        sock.sendall(hdr + b'{"kernel": "'
+                     + b"x" * max(0, int(body_sent) - 13) + b'"')
+        time.sleep(0.05)  # let the body read start before the tear
+        return "torn"
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        return "torn"
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _attack_fuzz(host: str, port: int, *, seed: int = 0) -> str:
+    """Spray bytes that are not HTTP where a request line should be;
+    a healthy front end answers 400 (``rejected``) or drops the
+    connection (``dropped``) — never hangs (``ignored``)."""
+    rng = np.random.RandomState(seed)
+    try:
+        sock = socket.create_connection((host, port), timeout=2.0)
+    except OSError:
+        return "refused"
+    try:
+        junk = bytes(rng.randint(1, 255, size=64, dtype=np.uint8))
+        sock.sendall(junk + b"\r\n\r\n")
+        sock.settimeout(2.0)
+        try:
+            data = sock.recv(512)
+        except socket.timeout:
+            return "ignored"
+        if not data:
+            return "dropped"
+        return "rejected" if data.startswith(b"HTTP/") else "dropped"
+    except (BrokenPipeError, ConnectionResetError, OSError):
+        return "dropped"
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def run_hostile(url: str, *, mode: str, n_conns: int = 8,
+                duration_s: float = 3.0, interval_s: float = 0.4,
+                seed: int = 0,
+                stop: "threading.Event | None" = None) -> dict:
+    """Launch ``n_conns`` concurrent attackers of one mode and report
+    the per-mode outcome census.  Every attacker thread is joined (with
+    a margin past ``duration_s``); stragglers count as ``hung`` — the
+    drill's no-hung-threads witness."""
+    if mode not in HOSTILE_MODES:
+        raise ValueError(f"unknown hostile mode {mode!r}")
+    shield_sigpipe()
+    host, port = _hostile_target(url)
+    outcomes: list[str] = []
+    lock = threading.Lock()
+
+    def attacker(ci: int):
+        if mode == "slowloris":
+            out = _attack_slowloris(host, port, duration_s=duration_s,
+                                    interval_s=interval_s, stop=stop)
+        elif mode == "torn":
+            out = _attack_torn(host, port)
+        else:
+            out = _attack_fuzz(host, port, seed=seed + ci)
+        with lock:
+            outcomes.append(out)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=attacker, args=(ci,),
+                                daemon=True)
+               for ci in range(max(1, int(n_conns)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(duration_s + 5.0)
+    hung = sum(1 for t in threads if t.is_alive())
+    census: dict[str, int] = {}
+    with lock:
+        for o in outcomes:
+            census[o] = census.get(o, 0) + 1
+    return {
+        "mode": mode,
+        "conns": int(n_conns),
+        "outcomes": dict(sorted(census.items())),
+        "hung": hung,
+        "duration_s": round(time.perf_counter() - t0, 3),
+    }
 
 
 # ------------------------------------------------------------ runners
@@ -888,6 +1059,14 @@ def main(argv=None) -> int:
                          "(t000..) via the X-Tenant header")
     ap.add_argument("--zipf", type=float, default=1.1, metavar="S",
                     help="Zipf skew of the tenant draw (--tenants)")
+    ap.add_argument("--hostile", choices=HOSTILE_MODES,
+                    help="attack-class mode: raw-socket slowloris / "
+                         "torn-body / fuzz clients instead of clean "
+                         "traffic (docs/resilience.md)")
+    ap.add_argument("--conns", type=int, default=8,
+                    help="concurrent attacker connections (--hostile)")
+    ap.add_argument("--interval", type=float, default=0.4,
+                    help="slowloris trickle interval, seconds")
     ap.add_argument("--timeout", type=float, default=2.0,
                     help="per-request timeout_s")
     ap.add_argument("--retries", type=int, default=2,
@@ -905,6 +1084,14 @@ def main(argv=None) -> int:
         return 0
     if not args.url:
         ap.error("--url is required (or use --bench)")
+    if args.hostile:
+        summary = run_hostile(args.url, mode=args.hostile,
+                              n_conns=args.conns,
+                              duration_s=args.duration,
+                              interval_s=args.interval,
+                              seed=args.seed)
+        print(json.dumps(summary))
+        return 0 if not summary["hung"] else 1
     kernels = tuple(s for s in args.kernels.split(",") if s)
     rows = tuple(int(s) for s in args.rows.split(",") if s)
     if not 0.0 <= args.mix <= 1.0:
